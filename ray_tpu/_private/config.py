@@ -1,0 +1,130 @@
+"""Typed flag registry with environment-variable overrides.
+
+Counterpart of the reference's RAY_CONFIG system (reference:
+src/ray/common/ray_config_def.h — 216 flags, each overridable via ``RAY_<name>``;
+src/ray/common/ray_config.h:102 for the getenv hook).  Here every flag is declared
+once with a type and default, and ``RAY_TPU_<NAME>`` env vars override it at first
+read.  Flags are process-local; cross-process propagation happens by the parent
+serializing overrides into the child's environment (see _private/services.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Callable, Dict
+
+ENV_PREFIX = "RAY_TPU_"
+
+
+def _parse_bool(v: str) -> bool:
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+_PARSERS: Dict[type, Callable[[str], Any]] = {
+    bool: _parse_bool,
+    int: int,
+    float: float,
+    str: str,
+}
+
+
+class _Config:
+    def __init__(self):
+        self._defs: Dict[str, tuple] = {}  # name -> (type, default)
+        self._values: Dict[str, Any] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, typ: type, default: Any, doc: str = ""):
+        self._defs[name] = (typ, default, doc)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        try:
+            typ, default, _ = self._defs[name]
+        except KeyError:
+            raise AttributeError(f"unknown config flag: {name}") from None
+        with self._lock:
+            if name not in self._values:
+                env = os.environ.get(ENV_PREFIX + name.upper())
+                if env is None:
+                    env = os.environ.get(ENV_PREFIX + name)
+                self._values[name] = _PARSERS[typ](env) if env is not None else default
+            return self._values[name]
+
+    def set(self, name: str, value: Any):
+        """Programmatic override (tests)."""
+        if name not in self._defs:
+            raise AttributeError(f"unknown config flag: {name}")
+        with self._lock:
+            self._values[name] = value
+
+    def reset(self, name: str | None = None):
+        with self._lock:
+            if name is None:
+                self._values.clear()
+            else:
+                self._values.pop(name, None)
+
+    def overrides_as_env(self) -> Dict[str, str]:
+        """Serialize explicitly-set values as env vars for child processes."""
+        with self._lock:
+            out = {}
+            for name, value in self._values.items():
+                typ, default, _ = self._defs[name]
+                if value != default:
+                    out[ENV_PREFIX + name.upper()] = json.dumps(value) if typ is bool else str(value)
+            return out
+
+    def dump(self) -> Dict[str, Any]:
+        return {name: getattr(self, name) for name in self._defs}
+
+
+RayConfig = _Config()
+_d = RayConfig.define
+
+# --- Timeouts & heartbeats (ms unless noted) ---
+_d("heartbeat_interval_ms", int, 500, "nodelet -> GCS resource/health report period")
+_d("health_check_timeout_ms", int, 10_000, "GCS marks a node dead after this silence")
+_d("gcs_rpc_timeout_s", float, 30.0, "client-side timeout for GCS RPCs")
+_d("worker_register_timeout_s", float, 60.0, "worker must register with nodelet within this")
+_d("wait_poll_interval_ms", int, 20, "poll granularity for ray.wait fallbacks")
+
+# --- Worker pool ---
+_d("num_initial_python_workers", int, 0, "workers pre-started per nodelet")
+_d("maximum_startup_concurrency", int, 4, "max concurrently-starting workers")
+_d("idle_worker_killing_time_ms", int, 300_000, "idle worker reap delay")
+_d("max_io_workers", int, 2, "spill/restore IO workers")
+
+# --- Scheduler ---
+_d("scheduler_spread_threshold", float, 0.5, "hybrid policy: pack below this utilization, then spread")
+_d("scheduler_top_k_fraction", float, 0.2, "hybrid policy: random choice among top-k nodes")
+_d("max_pending_lease_requests_per_scheduling_category", int, 10, "pipelined lease requests")
+
+# --- Object store ---
+_d("object_store_memory_bytes", int, 2 * 1024**3, "default per-node shm store capacity")
+_d("max_direct_call_object_size", int, 100 * 1024, "objects <= this are inlined in the owner memory store")
+_d("object_store_full_delay_ms", int, 100, "retry delay when store is full")
+_d("fetch_chunk_bytes", int, 8 * 1024**2, "chunk size for node-to-node object transfer")
+
+# --- Fault tolerance ---
+_d("task_max_retries_default", int, 3, "default retries for tasks (on worker/node death)")
+_d("actor_max_restarts_default", int, 0, "default actor restarts")
+_d("lineage_enabled", bool, True, "enable lineage-based object recovery")
+_d("max_lineage_bytes", int, 256 * 1024**2, "lineage retention budget per owner")
+
+# --- Metrics / events ---
+_d("event_stats", bool, True, "record per-handler event-loop stats")
+_d("metrics_report_interval_ms", int, 5_000, "metrics push period")
+_d("task_events_enabled", bool, True, "buffer + flush task lifecycle events to GCS")
+_d("task_events_flush_interval_ms", int, 1_000, "task event flush period")
+_d("task_events_max_buffer_size", int, 10_000, "drop task events beyond this")
+
+# --- Logging ---
+_d("log_to_driver", bool, True, "forward worker stdout/stderr to the driver")
+
+# --- Collectives ---
+_d("collective_rendezvous_timeout_s", float, 60.0, "collective group formation timeout")
+_d("collective_op_timeout_s", float, 300.0, "single collective op timeout")

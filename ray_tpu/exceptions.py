@@ -1,0 +1,154 @@
+"""Public exception hierarchy.
+
+Counterpart of the reference's python/ray/exceptions.py (RayError, RayTaskError,
+RayActorError, GetTimeoutError, ObjectLostError, ...) backed by C++ status codes
+(reference: src/ray/common/status.h).  Task-side exceptions are captured with a
+formatted remote traceback and re-raised owner-side wrapped in ``RayTaskError`` so
+the cause chain survives process boundaries.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayError(Exception):
+    """Base class for all framework errors."""
+
+
+class RaySystemError(RayError):
+    """The runtime itself failed (control-plane crash, protocol error)."""
+
+
+class RayTaskError(RayError):
+    """A task raised an exception remotely.
+
+    Carries the remote traceback string; ``as_instanceof_cause`` returns an
+    exception that is also an instance of the user's exception type so
+    ``except UserError`` works across process boundaries (mirrors reference
+    python/ray/exceptions.py RayTaskError.as_instanceof_cause).
+    """
+
+    def __init__(
+        self,
+        function_name: str = "",
+        traceback_str: str = "",
+        cause: Optional[BaseException] = None,
+    ):
+        super().__init__(function_name, traceback_str)
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+
+    @classmethod
+    def from_exception(cls, function_name: str, exc: BaseException) -> "RayTaskError":
+        tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+        return cls(function_name, tb, exc)
+
+    def as_instanceof_cause(self) -> "RayTaskError":
+        cause = self.cause
+        if cause is None or isinstance(cause, RayTaskError):
+            return self
+        cause_cls = type(cause)
+        if cause_cls is AssertionError or issubclass(cause_cls, (SystemExit, KeyboardInterrupt)):
+            return self
+
+        name = f"RayTaskError({cause_cls.__name__})"
+        try:
+            class _cls(RayTaskError, cause_cls):  # type: ignore[misc, valid-type]
+                def __init__(self, function_name, traceback_str, cause):
+                    RayTaskError.__init__(self, function_name, traceback_str, cause)
+
+                def __str__(self):
+                    return RayTaskError.__str__(self)
+
+                def __reduce__(self):
+                    return (
+                        _make_task_error,
+                        (cause_cls, self.function_name, self.traceback_str, self.cause),
+                    )
+
+            _cls.__name__ = name
+            _cls.__qualname__ = name
+            return _cls(self.function_name, self.traceback_str, cause)
+        except TypeError:
+            return self
+
+    def __str__(self):
+        return (
+            f"{type(self).__name__}: task {self.function_name} failed.\n"
+            f"Remote traceback:\n{self.traceback_str}"
+        )
+
+
+def _make_task_error(cause_cls, function_name, traceback_str, cause):
+    err = RayTaskError(function_name, traceback_str, cause)
+    return err.as_instanceof_cause()
+
+
+class TaskCancelledError(RayError):
+    pass
+
+
+class RayActorError(RayError):
+    """The actor died before or during this call."""
+
+    def __init__(self, actor_id=None, error_msg: str = ""):
+        super().__init__(error_msg or f"The actor died unexpectedly: {actor_id}")
+        self.actor_id = actor_id
+
+
+class ActorDiedError(RayActorError):
+    pass
+
+
+class ActorUnavailableError(RayActorError):
+    """The actor is temporarily unreachable (restarting or network partition)."""
+
+
+class GetTimeoutError(RayError, TimeoutError):
+    pass
+
+
+class ObjectStoreFullError(RayError):
+    pass
+
+
+class OutOfMemoryError(RayError):
+    pass
+
+
+class ObjectLostError(RayError):
+    def __init__(self, object_id=None, msg: str = ""):
+        super().__init__(msg or f"Object {object_id} was lost and could not be recovered.")
+        self.object_id = object_id
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    pass
+
+
+class OwnerDiedError(ObjectLostError):
+    def __init__(self, object_id=None):
+        super().__init__(object_id, f"The owner of object {object_id} died; the value is unrecoverable.")
+
+
+class RuntimeEnvSetupError(RayError):
+    pass
+
+
+class NodeDiedError(RayError):
+    pass
+
+
+class PlacementGroupSchedulingError(RayError):
+    pass
+
+
+class WorkerCrashedError(RayError):
+    pass
+
+
+class CollectiveError(RayError):
+    """A collective operation failed (peer death, timeout, shape mismatch)."""

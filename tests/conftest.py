@@ -1,0 +1,43 @@
+"""Shared test config.
+
+Force JAX onto a virtual 8-device CPU platform (multi-chip sharding is tested on a
+host-device mesh; real TPU runs happen in bench.py, not pytest) — mirrors how the
+reference tests TPU scheduling on CPU by faking topology (reference:
+python/ray/tests/accelerators/test_tpu.py).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    """Start a fresh single-node runtime for a test, like the reference fixture
+    python/ray/tests/conftest.py:419."""
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """Multi-node in-process cluster factory (reference: conftest.py:500 +
+    cluster_utils.Cluster)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster()
+    yield cluster
+    cluster.shutdown()

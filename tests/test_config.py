@@ -1,0 +1,32 @@
+import os
+
+from ray_tpu._private.config import RayConfig
+
+
+def test_defaults():
+    assert RayConfig.heartbeat_interval_ms == 500
+    assert RayConfig.lineage_enabled is True
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SCHEDULER_SPREAD_THRESHOLD", "0.75")
+    RayConfig.reset("scheduler_spread_threshold")
+    assert RayConfig.scheduler_spread_threshold == 0.75
+    RayConfig.reset("scheduler_spread_threshold")
+
+
+def test_set_and_overrides_env():
+    RayConfig.set("max_io_workers", 5)
+    try:
+        assert RayConfig.max_io_workers == 5
+        env = RayConfig.overrides_as_env()
+        assert env["RAY_TPU_MAX_IO_WORKERS"] == "5"
+    finally:
+        RayConfig.reset("max_io_workers")
+
+
+def test_unknown_flag():
+    import pytest
+
+    with pytest.raises(AttributeError):
+        RayConfig.no_such_flag

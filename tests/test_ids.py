@@ -1,0 +1,44 @@
+import pickle
+
+from ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    PlacementGroupID,
+    TaskID,
+)
+
+
+def test_sizes_and_roundtrip():
+    job = JobID.from_int(7)
+    assert job.int_value() == 7
+    actor = ActorID.of(job)
+    assert actor.job_id() == job
+    task = TaskID.for_actor_task(actor)
+    assert task.actor_id() == actor
+    assert task.job_id() == job
+    obj = ObjectID.from_task(task, 3)
+    assert obj.task_id() == task
+    assert obj.index() == 3
+    assert obj.job_id() == job
+
+
+def test_hex_and_pickle():
+    n = NodeID.from_random()
+    assert NodeID.from_hex(n.hex()) == n
+    assert pickle.loads(pickle.dumps(n)) == n
+    assert len({NodeID.from_random() for _ in range(100)}) == 100
+
+
+def test_nil():
+    assert PlacementGroupID.nil().is_nil()
+    assert not PlacementGroupID.from_random().is_nil()
+
+
+def test_normal_task_has_nil_actor():
+    job = JobID.from_int(1)
+    t = TaskID.for_task(job)
+    assert t.job_id() == job
+    # actor part is nil-unique prefix
+    assert t.actor_id().binary()[:12] == b"\xff" * 12

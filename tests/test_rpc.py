@@ -1,0 +1,134 @@
+import asyncio
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.rpc import Connection, EventLoopThread, Server, connect
+
+
+@pytest.fixture
+def io():
+    t = EventLoopThread("test-io")
+    yield t
+    t.stop()
+
+
+def test_basic_call(io):
+    async def echo(conn, obj):
+        return ("echo", obj)
+
+    async def setup():
+        server = Server({"echo": echo}, name="s")
+        host, port = await server.start()
+        conn = await connect(host, port)
+        return server, conn
+
+    server, conn = io.run(setup())
+    assert conn.call_sync("echo", {"x": 1}) == ("echo", {"x": 1})
+    io.run(conn.close())
+    io.run(server.stop())
+
+
+def test_large_buffer_roundtrip(io):
+    async def double(conn, obj):
+        return obj * 2
+
+    async def setup():
+        server = Server({"double": double})
+        host, port = await server.start()
+        conn = await connect(host, port)
+        return server, conn
+
+    server, conn = io.run(setup())
+    arr = np.arange(1_000_000, dtype=np.float64)
+    out = conn.call_sync("double", arr)
+    np.testing.assert_array_equal(out, arr * 2)
+    io.run(server.stop())
+
+
+def test_handler_error_propagates(io):
+    async def boom(conn, obj):
+        raise ValueError("kaboom")
+
+    async def setup():
+        server = Server({"boom": boom})
+        host, port = await server.start()
+        conn = await connect(host, port)
+        return server, conn
+
+    server, conn = io.run(setup())
+    with pytest.raises(ValueError, match="kaboom"):
+        conn.call_sync("boom")
+    io.run(server.stop())
+
+
+def test_server_push_to_client(io):
+    """Bidirectional: server calls a handler registered on the client side."""
+    got = []
+
+    async def client_handler(conn, obj):
+        got.append(obj)
+        return obj + 1
+
+    server_conns = []
+
+    async def register(conn, obj):
+        server_conns.append(conn)
+        return "ok"
+
+    async def setup():
+        server = Server({"register": register})
+        host, port = await server.start()
+        conn = await connect(host, port, handlers={"ping": client_handler})
+        return server, conn
+
+    server, conn = io.run(setup())
+    assert conn.call_sync("register") == "ok"
+
+    async def push():
+        return await server_conns[0].call("ping", 41)
+
+    assert io.run(push()) == 42
+    assert got == [41]
+    io.run(server.stop())
+
+
+def test_connection_lost_fails_pending(io):
+    async def hang(conn, obj):
+        await asyncio.sleep(30)
+
+    async def setup():
+        server = Server({"hang": hang})
+        host, port = await server.start()
+        conn = await connect(host, port)
+        return server, conn
+
+    server, conn = io.run(setup())
+
+    fut = io.spawn(conn.call("hang"))
+    import time
+
+    time.sleep(0.1)
+    io.run(server.stop())
+    with pytest.raises(Exception):
+        fut.result(timeout=5)
+
+
+def test_concurrent_calls(io):
+    async def slow_id(conn, obj):
+        await asyncio.sleep(0.05)
+        return obj
+
+    async def setup():
+        server = Server({"id": slow_id})
+        host, port = await server.start()
+        conn = await connect(host, port)
+        return server, conn
+
+    server, conn = io.run(setup())
+
+    async def many():
+        return await asyncio.gather(*[conn.call("id", i) for i in range(20)])
+
+    assert io.run(many()) == list(range(20))
+    io.run(server.stop())

@@ -1,0 +1,71 @@
+import numpy as np
+import pytest
+
+from ray_tpu._private.serialization import (
+    SerializedObject,
+    get_serialization_context,
+)
+
+
+def test_roundtrip_small():
+    ctx = get_serialization_context()
+    v = {"a": 1, "b": [1, 2, 3], "c": "hello"}
+    s = ctx.serialize(v)
+    assert ctx.deserialize(s) == v
+    assert s.buffers == []
+
+
+def test_numpy_out_of_band_zero_copy():
+    ctx = get_serialization_context()
+    arr = np.arange(100_000, dtype=np.float32)
+    s = ctx.serialize(arr)
+    assert len(s.buffers) == 1
+    assert s.buffers[0].nbytes == arr.nbytes
+    out = ctx.deserialize(s)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_flatten_roundtrip():
+    ctx = get_serialization_context()
+    arr = np.random.rand(512, 512)
+    s = ctx.serialize({"x": arr, "y": "meta"})
+    flat = s.to_bytes()
+    s2 = SerializedObject.from_buffer(flat)
+    out = ctx.deserialize(s2)
+    np.testing.assert_array_equal(out["x"], arr)
+    assert out["y"] == "meta"
+
+
+def test_custom_serializer():
+    ctx = get_serialization_context()
+
+    class Weird:
+        def __init__(self, v):
+            self.v = v
+
+        def __reduce__(self):
+            raise TypeError("not picklable")
+
+    ctx.register_serializer(Weird, lambda w: w.v, lambda v: Weird(v * 2))
+    try:
+        out = ctx.deserialize(ctx.serialize(Weird(21)))
+        assert out.v == 42
+    finally:
+        ctx.deregister_serializer(Weird)
+    with pytest.raises(Exception):
+        ctx.serialize(Weird(1))
+
+
+def test_lambda_cloudpickle():
+    ctx = get_serialization_context()
+    f = ctx.deserialize(ctx.serialize(lambda x: x + 1))
+    assert f(1) == 2
+
+
+def test_jax_array_serializes_to_host():
+    import jax.numpy as jnp
+
+    ctx = get_serialization_context()
+    arr = jnp.arange(10000, dtype=jnp.float32)
+    out = ctx.deserialize(ctx.serialize(arr))
+    np.testing.assert_array_equal(np.asarray(arr), np.asarray(out))
