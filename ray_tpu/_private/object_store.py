@@ -1,0 +1,425 @@
+"""Shared-memory object store: the plasma equivalent.
+
+Counterpart of the reference's plasma store (reference: src/ray/object_manager/plasma/
+store.h:55, object_lifecycle_manager.h:101, eviction_policy.h, plasma_allocator.cc) and
+the client side (src/ray/core_worker/store_provider/plasma_store_provider.h:88).
+
+Design, TPU-host-native rather than a translation:
+
+- One store per node, hosted inside the nodelet (raylet-equivalent) process.  Objects
+  live in POSIX shared memory (``/dev/shm`` via ``multiprocessing.shared_memory``),
+  one segment per object.  The reference instead dlmalloc's one big mmap arena and
+  passes fds (plasma/fling.cc); per-object segments let clients attach by *name* over
+  the normal RPC channel — no fd-passing — at the cost of one ``memfd`` per object,
+  which is fine at the object counts a training cluster sees and removes the whole
+  allocator (XLA owns device memory; host shm is a staging area).
+- Zero-copy reads: clients map the segment and deserialize with pickle-5 buffers
+  pointing straight into it (numpy arrays alias shm).  The mapping outlives deletion:
+  POSIX keeps unlinked segments alive until the last mapping closes, which is exactly
+  the pin-until-last-view semantics plasma implements with refcounts.
+- Eviction & spilling: sealed, unpinned objects are spilled to disk (primary copies)
+  or evicted (remote copies) in LRU order when a create needs room (reference:
+  eviction_policy.h + local_object_manager.h:41 spill path, simplified into one
+  component).  Restore happens transparently inside ``get``.
+- Admission: creates larger than free capacity + evictable bytes raise
+  ``ObjectStoreFullError`` after retrying, like the CreateRequestQueue
+  (plasma/create_request_queue.h).
+
+Server-side methods are synchronous and only called from the nodelet's event loop
+(single-threaded, like the reference store's single io_context thread).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from multiprocessing import resource_tracker, shared_memory
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private.config import RayConfig
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.exceptions import ObjectStoreFullError
+
+logger = logging.getLogger(__name__)
+
+
+def _attach_shm(name: str) -> shared_memory.SharedMemory:
+    """Attach to an existing segment without registering with resource_tracker.
+
+    The tracker would try to unlink segments owned by the store when *this*
+    process exits; only the store unlinks.
+    """
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+    except Exception:
+        pass
+    orig_close = shm.close
+
+    def _close_tolerant():
+        try:
+            orig_close()
+        except BufferError:
+            # A zero-copy numpy view still aliases the mapping (interpreter
+            # shutdown / GC); the segment is store-owned, leaking the mapping
+            # until process exit matches plasma's pin semantics.
+            pass
+
+    shm.close = _close_tolerant
+    return shm
+
+
+class _Entry:
+    __slots__ = (
+        "oid", "shm", "size", "sealed", "pins", "last_access",
+        "is_primary", "spilled_path", "create_t",
+    )
+
+    def __init__(self, oid: ObjectID, shm: Optional[shared_memory.SharedMemory], size: int, is_primary: bool):
+        self.oid = oid
+        self.shm = shm
+        self.size = size
+        self.sealed = False
+        self.pins = 0  # outstanding client pins; only 0-pin objects evict
+        self.last_access = time.monotonic()
+        self.is_primary = is_primary  # created locally by owner (vs pulled copy)
+        self.spilled_path: Optional[str] = None
+        self.create_t = time.monotonic()
+
+
+class PlasmaStore:
+    """Node-local shared-memory store. All methods run on the nodelet loop."""
+
+    def __init__(self, capacity_bytes: int, spill_dir: Optional[str] = None, node_id_hex: str = ""):
+        self.capacity = capacity_bytes
+        self.used = 0
+        self.objects: Dict[ObjectID, _Entry] = {}
+        self.spill_dir = spill_dir
+        self.node_id_hex = node_id_hex
+        self._seq = 0
+        # Callbacks wired by the nodelet: object sealed / deleted locally
+        # (feeds the GCS object directory, reference: ownership_based_object_directory.h).
+        self.on_sealed = None
+        self.on_deleted = None
+        self.num_spilled = 0
+        self.bytes_spilled = 0
+
+    # -- helpers -------------------------------------------------------------
+    def _segment_name(self) -> str:
+        self._seq += 1
+        return f"rtpu_{self.node_id_hex[:8]}_{os.getpid()}_{self._seq}"
+
+    def _evictable(self) -> List[_Entry]:
+        return [
+            e for e in self.objects.values()
+            if e.sealed and e.pins == 0 and e.shm is not None
+        ]
+
+    def _ensure_room(self, size: int) -> bool:
+        if self.used + size <= self.capacity:
+            return True
+        victims = sorted(self._evictable(), key=lambda e: e.last_access)
+        for e in victims:
+            if self.used + size <= self.capacity:
+                break
+            if e.is_primary and self.spill_dir:
+                self._spill(e)
+            else:
+                self._drop_shm(e)
+                if not e.spilled_path:
+                    del self.objects[e.oid]
+                    if self.on_deleted:
+                        self.on_deleted(e.oid)
+        return self.used + size <= self.capacity
+
+    def _spill(self, e: _Entry) -> None:
+        os.makedirs(self.spill_dir, exist_ok=True)
+        path = os.path.join(self.spill_dir, e.oid.hex())
+        with open(path, "wb") as f:
+            f.write(e.shm.buf)
+        e.spilled_path = path
+        self.num_spilled += 1
+        self.bytes_spilled += e.size
+        self._drop_shm(e)
+
+    def _restore(self, e: _Entry) -> None:
+        name = self._segment_name()
+        if not self._ensure_room(e.size):
+            raise ObjectStoreFullError(
+                f"cannot restore {e.oid}: store full ({self.used}/{self.capacity})"
+            )
+        shm = shared_memory.SharedMemory(name=name, create=True, size=max(e.size, 1))
+        with open(e.spilled_path, "rb") as f:
+            f.readinto(shm.buf)
+        e.shm = shm
+        self.used += e.size
+
+    def _drop_shm(self, e: _Entry) -> None:
+        if e.shm is not None:
+            self.used -= e.size
+            try:
+                e.shm.unlink()
+            except FileNotFoundError:
+                pass
+            try:
+                e.shm.close()
+            except BufferError:
+                # A transient server-side view (push/spill in flight) still
+                # aliases the buffer; the segment is unlinked so the pages are
+                # reclaimed when the mapping dies with the view.
+                pass
+            e.shm = None
+
+    # -- API -----------------------------------------------------------------
+    def create(self, oid: ObjectID, size: int, is_primary: bool = True) -> str:
+        """Allocate a segment for oid; returns the shm name for the client to map."""
+        if oid in self.objects:
+            e = self.objects[oid]
+            if e.sealed:
+                raise FileExistsError(f"object {oid} already sealed")
+            # Re-create (e.g. failed writer): drop the half-written segment.
+            self._drop_shm(e)
+            del self.objects[oid]
+        if size > self.capacity:
+            raise ObjectStoreFullError(
+                f"object of {size} bytes exceeds store capacity {self.capacity}"
+            )
+        if not self._ensure_room(size):
+            raise ObjectStoreFullError(
+                f"store full: need {size}, used {self.used}/{self.capacity}, "
+                f"evictable {sum(x.size for x in self._evictable())}"
+            )
+        name = self._segment_name()
+        shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
+        e = _Entry(oid, shm, size, is_primary)
+        self.objects[oid] = e
+        self.used += size
+        return name
+
+    def seal(self, oid: ObjectID) -> None:
+        e = self.objects[oid]
+        e.sealed = True
+        e.last_access = time.monotonic()
+        if self.on_sealed:
+            self.on_sealed(oid, e.size)
+
+    def write_and_seal(self, oid: ObjectID, data: memoryview, is_primary: bool = True) -> None:
+        """Server-side path used by object transfer (pull) and spill restore."""
+        if self.contains(oid):
+            return
+        name = self.create(oid, data.nbytes, is_primary=is_primary)
+        e = self.objects[oid]
+        e.shm.buf[: data.nbytes] = data
+        del name
+        self.seal(oid)
+
+    def contains(self, oid: ObjectID) -> bool:
+        e = self.objects.get(oid)
+        return e is not None and e.sealed
+
+    def get_local(self, oid: ObjectID, pin: bool = True) -> Optional[Tuple[Optional[str], int]]:
+        """Return (shm_name, size) for a sealed local object, restoring from spill.
+
+        shm_name is None only if the object is unknown. Pins the object so it
+        survives until the client releases it.
+        """
+        e = self.objects.get(oid)
+        if e is None or not e.sealed:
+            return None
+        if e.shm is None and e.spilled_path:
+            self._restore(e)
+        e.last_access = time.monotonic()
+        if pin:
+            e.pins += 1
+        return (e.shm.name, e.size)
+
+    def read_bytes(self, oid: ObjectID) -> Optional[memoryview]:
+        """Server-side view of the object payload (for node-to-node push)."""
+        e = self.objects.get(oid)
+        if e is None or not e.sealed:
+            return None
+        if e.shm is None and e.spilled_path:
+            self._restore(e)
+        e.last_access = time.monotonic()
+        return e.shm.buf[: e.size]
+
+    def release(self, oid: ObjectID) -> None:
+        e = self.objects.get(oid)
+        if e is not None and e.pins > 0:
+            e.pins -= 1
+
+    def delete(self, oid: ObjectID) -> None:
+        e = self.objects.pop(oid, None)
+        if e is None:
+            return
+        self._drop_shm(e)
+        if e.spilled_path:
+            try:
+                os.remove(e.spilled_path)
+            except OSError:
+                pass
+        if self.on_deleted:
+            self.on_deleted(oid)
+
+    def stats(self) -> dict:
+        return {
+            "capacity": self.capacity,
+            "used": self.used,
+            "num_objects": len(self.objects),
+            "num_spilled": self.num_spilled,
+            "bytes_spilled": self.bytes_spilled,
+        }
+
+    def shutdown(self) -> None:
+        for oid in list(self.objects):
+            self.delete(oid)
+
+
+class PlasmaClient:
+    """Client-side zero-copy access, used by CoreWorker.
+
+    Methods are synchronous and called from the user thread; RPC metadata rides the
+    worker's IO loop, the data path is direct shm mapping (reference:
+    plasma_store_provider.h:88; zero-copy get semantics of plasma).
+    """
+
+    def __init__(self, io, conn):
+        # io: EventLoopThread, conn: Connection to the local nodelet
+        self._io = io
+        self._conn = conn
+        self._mappings: Dict[ObjectID, shared_memory.SharedMemory] = {}
+
+    def put(self, oid: ObjectID, flat: memoryview | bytes) -> None:
+        """Create + write + seal one object."""
+        nbytes = flat.nbytes if isinstance(flat, memoryview) else len(flat)
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                resp = self._conn.call_sync("plasma_create", {"oid": oid.binary(), "size": nbytes})
+                break
+            except ObjectStoreFullError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(RayConfig.object_store_full_delay_ms / 1000.0)
+        if resp.get("exists"):
+            return
+        shm = _attach_shm(resp["name"])
+        try:
+            shm.buf[:nbytes] = flat
+        finally:
+            shm.close()
+        self._conn.call_sync("plasma_seal", {"oid": oid.binary()})
+
+    def get_mapped(self, oid: ObjectID, timeout: Optional[float] = None) -> Optional[memoryview]:
+        """Map a sealed object; returns a memoryview over shm or None on timeout.
+
+        The nodelet blocks server-side until the object is local (pulling from
+        remote nodes if needed), so no client-side polling.
+        """
+        resp = self._conn.call_sync(
+            "plasma_get", {"oid": oid.binary(), "timeout": timeout}, timeout=None
+        )
+        if resp is None:
+            return None
+        name, size = resp
+        if oid in self._mappings:
+            # Already pinned once by us; drop the extra server-side pin.
+            self._conn.call_sync("plasma_release", {"oid": oid.binary()})
+            shm = self._mappings[oid]
+        else:
+            shm = _attach_shm(name)
+            self._mappings[oid] = shm
+        return shm.buf[:size]
+
+    def contains(self, oid: ObjectID) -> bool:
+        return self._conn.call_sync("plasma_contains", {"oid": oid.binary()})
+
+    def release(self, oid: ObjectID) -> None:
+        shm = self._mappings.pop(oid, None)
+        if shm is not None:
+            try:
+                self._conn.call_sync("plasma_release", {"oid": oid.binary()})
+            except ConnectionError:
+                pass
+            # Close lazily: deserialized numpy arrays may alias this mapping.
+            # POSIX keeps the pages alive until close; we close only when no
+            # views exist, which we approximate by closing at release time if
+            # the buffer has no exports. memoryview tracking is implicit: shm
+            # keeps its own buffer; closing with live exports raises, so guard.
+            try:
+                shm.close()
+            except BufferError:
+                # A deserialized value still aliases the buffer; leak the
+                # mapping (freed at process exit) — same behavior as plasma
+                # pinning the object while a numpy view exists.
+                pass
+
+    def free(self, oids: List[ObjectID]) -> None:
+        try:
+            self._conn.call_sync("plasma_delete", {"oids": [o.binary() for o in oids]})
+        except ConnectionError:
+            pass
+
+
+def register_store_handlers(handlers: dict, store: PlasmaStore, waiters: dict) -> None:
+    """Wire plasma_* RPC methods into a nodelet server handler table.
+
+    ``waiters`` maps ObjectID -> list of asyncio futures resolved when the object
+    becomes local; the nodelet's pull manager also resolves these.
+    """
+    import asyncio
+
+    async def plasma_create(conn, msg):
+        oid = ObjectID(msg["oid"])
+        if store.contains(oid):
+            return {"exists": True}
+        name = store.create(oid, msg["size"])
+        return {"name": name, "exists": False}
+
+    async def plasma_seal(conn, msg):
+        oid = ObjectID(msg["oid"])
+        store.seal(oid)
+        for fut in waiters.pop(oid, []):
+            if not fut.done():
+                fut.set_result(True)
+        return True
+
+    async def plasma_get(conn, msg):
+        oid = ObjectID(msg["oid"])
+        timeout = msg.get("timeout")
+        entry = store.get_local(oid)
+        if entry is not None:
+            return entry
+        fut = asyncio.get_event_loop().create_future()
+        waiters.setdefault(oid, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            return None
+        return store.get_local(oid)
+
+    async def plasma_contains(conn, msg):
+        return store.contains(ObjectID(msg["oid"]))
+
+    async def plasma_release(conn, msg):
+        store.release(ObjectID(msg["oid"]))
+        return True
+
+    async def plasma_delete(conn, msg):
+        for b in msg["oids"]:
+            store.delete(ObjectID(b))
+        return True
+
+    async def plasma_stats(conn, msg):
+        return store.stats()
+
+    handlers.update(
+        plasma_create=plasma_create,
+        plasma_seal=plasma_seal,
+        plasma_get=plasma_get,
+        plasma_contains=plasma_contains,
+        plasma_release=plasma_release,
+        plasma_delete=plasma_delete,
+        plasma_stats=plasma_stats,
+    )

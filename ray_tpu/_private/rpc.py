@@ -59,7 +59,15 @@ def _encode(obj: Any) -> Tuple[bytes, list]:
         buffers.append(mv)
         return False
 
-    inband = pickle.dumps(obj, protocol=5, buffer_callback=cb)
+    try:
+        inband = pickle.dumps(obj, protocol=5, buffer_callback=cb)
+    except Exception:
+        # Control-plane payloads are plain data; anything exotic (closures,
+        # locally-defined exception classes) falls back to cloudpickle.
+        import cloudpickle
+
+        buffers.clear()
+        inband = cloudpickle.dumps(obj, protocol=5, buffer_callback=cb)
     return inband, buffers
 
 
@@ -141,7 +149,10 @@ class Connection:
         if self._closed:
             raise ConnectionLost(f"connection {self.name} is closed")
         inband, buffers = _encode(obj)
-        await self._send_frame({"t": T_NOTIFY, "id": 0, "m": method, "nbufs": len(buffers)}, inband, buffers)
+        try:
+            await self._send_frame({"t": T_NOTIFY, "id": 0, "m": method, "nbufs": len(buffers)}, inband, buffers)
+        except (ConnectionError, OSError) as e:
+            raise ConnectionLost(str(e)) from e
 
     def notify_sync(self, method: str, obj: Any = None, timeout: Optional[float] = 30.0):
         fut = asyncio.run_coroutine_threadsafe(self.notify(method, obj), self._loop)
@@ -177,8 +188,12 @@ class Connection:
                     if fut is not None and not fut.done():
                         if t == T_RES:
                             fut.set_result(obj)
-                        else:
+                        elif isinstance(obj, BaseException):
                             fut.set_exception(obj)
+                        else:
+                            fut.set_exception(
+                                RaySerializationError(f"malformed error reply: {obj!r}")
+                            )
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         except asyncio.CancelledError:
@@ -273,6 +288,10 @@ class Connection:
             if not fut.done():
                 fut.set_exception(ConnectionLost(f"connection {self.name} lost"))
         self._pending.clear()
+        # Cancel in-flight inbound handlers: they act on behalf of a peer that
+        # can no longer receive the reply.
+        for task in list(self._dispatch_tasks):
+            task.cancel()
         try:
             self._writer.close()
         except Exception:

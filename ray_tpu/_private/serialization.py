@@ -164,20 +164,7 @@ def get_serialization_context() -> SerializationContext:
     with _default_lock:
         if _default_context is None:
             _default_context = SerializationContext()
-            _register_builtin_serializers(_default_context)
         return _default_context
-
-
-def _register_builtin_serializers(ctx: SerializationContext) -> None:
-    # jax.Array: ship as a numpy host copy; re-materialized as a host numpy array
-    # on the receiver — device placement is the receiver's decision (an explicit
-    # design choice: cross-process device buffers move via host DRAM; the ICI
-    # fast path is the collective/channel layer, not pickling).
-    #
-    # Registered lazily via reducer_override's fallback below only if jax is
-    # already imported in this process — workers that never touch jax must not
-    # pay the import.
-    pass
 
 
 def maybe_register_jax(ctx: Optional[SerializationContext] = None) -> None:
