@@ -1,0 +1,119 @@
+"""ray_tpu: a TPU-native distributed runtime + AI libraries.
+
+A ground-up TPU-first framework with the capabilities of the reference Ray stack
+(reference: python/ray/__init__.py public surface): tasks, actors, objects,
+placement groups, collectives lowering to XLA/ICI, and the Train/Tune/Data/
+Serve/RLlib libraries built on top.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+from ray_tpu import exceptions
+from ray_tpu._private import worker as _worker
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.worker import (
+    get,
+    get_async,
+    init,
+    is_initialized,
+    put,
+    shutdown,
+    wait,
+)
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor, method
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.runtime_context import get_runtime_context
+
+__version__ = "0.1.0"
+
+
+def remote(*args, **kwargs):
+    """The @remote decorator (reference: python/ray/_private/worker.py:3151).
+
+    Usage::
+
+        @ray_tpu.remote
+        def f(x): ...
+
+        @ray_tpu.remote(num_cpus=2, num_tpus=4)
+        class Trainer: ...
+    """
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_cpus=2)")
+
+    def wrap(target):
+        if isinstance(target, type):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    return wrap
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    """Forcibly kill an actor (reference: ray.kill, worker.py:2828)."""
+    _worker.require_core().kill_actor(actor._actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
+    """Best-effort cancel of a pending task (reference: ray.cancel)."""
+    # Cooperative cancellation arrives with the task-manager milestone; the
+    # call is accepted so callers are portable.
+    import warnings
+
+    warnings.warn("ray_tpu.cancel is currently a no-op", stacklevel=2)
+
+
+def nodes() -> list:
+    """Cluster membership (reference: ray.nodes)."""
+    core = _worker.require_core()
+    view = core.io.run(core.gcs_conn.call("get_all_node_info", None))
+    out = []
+    for n in view:
+        out.append({
+            "NodeID": NodeID(n["node_id"]).hex(),
+            "Alive": n["alive"],
+            "NodeManagerAddress": n["addr"][0],
+            "NodeManagerPort": n["addr"][1],
+            "Resources": n["total"],
+            "Available": n["available"],
+            "NodeName": n.get("node_name", ""),
+            "Labels": n.get("labels", {}),
+        })
+    return out
+
+
+def cluster_resources() -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for n in nodes():
+        if not n["Alive"]:
+            continue
+        for k, v in n["Resources"].items():
+            out[k] = out.get(k, 0.0) + v
+    return out
+
+
+def available_resources() -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for n in nodes():
+        if not n["Alive"]:
+            continue
+        for k, v in n["Available"].items():
+            out[k] = out.get(k, 0.0) + v
+    return out
+
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "remote", "get", "put", "wait",
+    "kill", "cancel", "get_actor", "method", "nodes", "cluster_resources",
+    "available_resources", "get_runtime_context", "get_async",
+    "ObjectRef", "ActorHandle", "ActorClass", "RemoteFunction", "exceptions",
+    "ActorID", "JobID", "NodeID", "ObjectID", "TaskID", "WorkerID",
+]

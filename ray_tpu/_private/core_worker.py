@@ -1,0 +1,1044 @@
+"""CoreWorker: embedded runtime in every driver and worker process.
+
+Counterpart of the reference's CoreWorker (reference: src/ray/core_worker/
+core_worker.h:295, core_worker.cc) plus the pieces it owns:
+
+- task submission with lease-based scheduling + spillback
+  (NormalTaskSubmitter, transport/normal_task_submitter.h:75)
+- local dependency resolution + small-arg inlining
+  (LocalDependencyResolver, transport/dependency_resolver.h:29)
+- actor task submission with per-handle ordering over one TCP stream
+  (ActorTaskSubmitter, transport/actor_task_submitter.h:73 — sequence numbers are
+  implicit here: one connection per actor, FIFO stream, in-order dispatch)
+- task execution loop + scheduling queues (TaskReceiver, transport/task_receiver.h:51)
+- in-process memory store + plasma provider (store_provider/)
+- ownership & distributed GC (ReferenceCounter, reference_count.h:61)
+- lineage for retries (TaskManager, task_manager.h:208 — retries implemented,
+  lineage reconstruction arriving with object recovery)
+
+Threading model: one IO loop thread per process (all RPC), a small executor pool
+for running user task code (worker mode), and the user thread (driver mode) that
+blocks on memory-store events — mirroring the reference's io_service + task
+execution thread split.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import os
+import pickle
+import threading
+import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import RayConfig
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.memory_store import IN_PLASMA, MemoryStore
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_store import PlasmaClient
+from ray_tpu._private.reference_count import ReferenceCounter
+from ray_tpu._private.serialization import (
+    SerializedObject,
+    get_serialization_context,
+)
+from ray_tpu._private.task_spec import (
+    InlineArg,
+    RefArg,
+    SchedulingStrategy,
+    TaskSpec,
+    TaskType,
+)
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    OwnerDiedError,
+    RayActorError,
+    RaySystemError,
+    RayTaskError,
+    TaskCancelledError,
+    WorkerCrashedError,
+)
+
+logger = logging.getLogger(__name__)
+
+_FUNCTION_TABLE_THRESHOLD = 512 * 1024
+
+
+class _TaskContext(threading.local):
+    def __init__(self):
+        self.task_id: Optional[TaskID] = None
+        self.actor_id: Optional[ActorID] = None
+        self.job_id: Optional[JobID] = None
+        self.attempt_number: int = 0
+        self.task_name: str = ""
+
+
+class CoreWorker:
+    def __init__(
+        self,
+        mode: str,  # "driver" | "worker"
+        gcs_addr: Tuple[str, int],
+        nodelet_addr: Tuple[str, int],
+        worker_id: Optional[WorkerID] = None,
+        session_dir: str = "/tmp/ray_tpu",
+        node_id: Optional[NodeID] = None,
+        namespace: str = "",
+    ):
+        self.mode = mode
+        self.worker_id = worker_id or WorkerID.from_random()
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.namespace = namespace
+        self.job_id = JobID.from_int(0)
+        self.ctx = get_serialization_context()
+        self.task_ctx = _TaskContext()
+
+        self.io = rpc.EventLoopThread(name=f"rtpu-io-{mode}")
+        self.memory_store = MemoryStore()
+        self.ref_counter = ReferenceCounter(
+            self.worker_id.binary(), self._on_out_of_scope, self._notify_owner
+        )
+
+        # RPC server: owner services + task execution endpoint.
+        handlers = {}
+        for name in dir(self):
+            if name.startswith("rpc_"):
+                handlers[name[4:]] = getattr(self, name)
+        self.server = rpc.Server(handlers, name=f"worker-{self.worker_id.hex()[:6]}")
+        self.addr: Tuple[str, int] = self.io.run(self.server.start("127.0.0.1", 0))
+
+        # Connections.
+        self.nodelet_conn: rpc.Connection = self.io.run(
+            rpc.connect(*nodelet_addr, handlers=handlers, name="worker->nodelet")
+        )
+        self.gcs_conn: rpc.Connection = self.io.run(
+            rpc.connect(
+                *gcs_addr,
+                handlers={"publish": self._on_publish, **handlers},
+                name="worker->gcs",
+            )
+        )
+        self.plasma = PlasmaClient(self.io, self.nodelet_conn)
+
+        self._put_task_id = TaskID.for_task(JobID.from_int(0))
+        self._put_index = 0
+        self._put_lock = threading.Lock()
+
+        self._refs_lock = threading.Lock()
+        self._contained: Dict[ObjectID, List[ObjectRef]] = {}
+        self._owned_in_plasma: set = set()
+
+        self._owner_conns: Dict[Tuple[str, int], rpc.Connection] = {}
+        self._worker_conns: Dict[Tuple[str, int], rpc.Connection] = {}
+        self._nodelet_conns: Dict[Tuple[str, int], rpc.Connection] = {self_addr_key(nodelet_addr): self.nodelet_conn}
+        self._subscriptions: Dict[str, List] = {}
+
+        self.submitter = NormalTaskSubmitter(self)
+        self.actor_submitters: Dict[ActorID, ActorTaskSubmitter] = {}
+
+        self._fn_cache: Dict[str, Any] = {}
+        self._pushed_fns: set = set()
+
+        self._get_pool = ThreadPoolExecutor(max_workers=4, thread_name_prefix="rtpu-get")
+
+        # Executor state (worker mode).
+        self.executor_pool: Optional[ThreadPoolExecutor] = None
+        self.actor_instance: Any = None
+        self.actor_id: Optional[ActorID] = None
+        self._actor_sem: Optional[asyncio.Semaphore] = None
+        self._exec_queue: Optional[asyncio.Queue] = None
+        self._dispatch_task = None
+        if mode == "worker":
+            self.executor_pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="rtpu-exec")
+            self._exec_queue = asyncio.Queue()
+            self._dispatch_task = self.io.spawn(self._execute_loop())
+
+        self.shutdown_event = threading.Event()
+        self._shut = False
+
+    # ====================================================== setup / teardown
+    def register_with_nodelet(self):
+        return self.io.run(
+            self.nodelet_conn.call(
+                "register_worker",
+                {"worker_id": self.worker_id.binary(), "addr": list(self.addr),
+                 "pid": os.getpid()},
+            )
+        )
+
+    def register_driver(self, entrypoint: str = ""):
+        resp = self.io.run(
+            self.gcs_conn.call("register_job", {"driver_addr": list(self.addr),
+                                                "entrypoint": entrypoint})
+        )
+        self.job_id = JobID(resp["job_id"])
+        self._put_task_id = TaskID.for_task(self.job_id)
+        return self.job_id
+
+    def shutdown(self):
+        if self._shut:
+            return
+        self._shut = True
+        try:
+            self.io.run(self.server.stop(), timeout=5)
+        except Exception:
+            pass
+        for conn in [self.nodelet_conn, self.gcs_conn, *self._owner_conns.values(),
+                     *self._worker_conns.values()]:
+            try:
+                self.io.run(conn.close(), timeout=2)
+            except Exception:
+                pass
+        if self.executor_pool:
+            self.executor_pool.shutdown(wait=False)
+        self._get_pool.shutdown(wait=False)
+        self.io.stop()
+
+    # ============================================================== pub/sub
+    async def _on_publish(self, conn, msg):
+        for cb in self._subscriptions.get(msg["channel"], []):
+            try:
+                res = cb(msg["data"])
+                if asyncio.iscoroutine(res):
+                    await res
+            except Exception:
+                logger.exception("subscription callback failed for %s", msg["channel"])
+
+    def subscribe(self, channel: str, cb) -> None:
+        self._subscriptions.setdefault(channel, []).append(cb)
+        self.io.run(self.gcs_conn.call("subscribe", {"channel": channel}))
+
+    # ======================================================== object: put/get
+    def _next_put_id(self) -> ObjectID:
+        with self._put_lock:
+            self._put_index += 1
+            return ObjectID.from_task(self._put_task_id, self._put_index)
+
+    def put(self, value: Any) -> ObjectRef:
+        ser = self.ctx.serialize(value)
+        oid = self._next_put_id()
+        self.ref_counter.add_owned(oid, initial_local=0)
+        if ser.total_bytes() > RayConfig.max_direct_call_object_size:
+            self.plasma.put(oid, memoryview(ser.to_bytes()))
+            self.memory_store.put(oid, IN_PLASMA)
+            with self._refs_lock:
+                self._owned_in_plasma.add(oid)
+        else:
+            self.memory_store.put(oid, ser)
+        if ser.contained_refs:
+            with self._refs_lock:
+                self._contained[oid] = list(ser.contained_refs)
+        return ObjectRef(oid, self.addr, self.worker_id.binary())
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        return [self._resolve_one(r, deadline) for r in refs]
+
+    def _remaining(self, deadline) -> Optional[float]:
+        if deadline is None:
+            return None
+        rem = deadline - time.monotonic()
+        if rem <= 0:
+            raise GetTimeoutError("ray.get timed out")
+        return rem
+
+    def _resolve_one(self, ref: ObjectRef, deadline=None) -> Any:
+        oid = ref.oid
+        # 1. The in-process memory store (owned objects & cached borrows).
+        if self.memory_store.known(oid):
+            if not self.memory_store.wait_ready(oid, self._remaining(deadline)):
+                raise GetTimeoutError(f"object {oid.hex()} not ready within timeout")
+            ok, value, err = self.memory_store.get_if_ready(oid)
+            if err is not None:
+                raise err
+            if value is IN_PLASMA:
+                return self._get_from_plasma(oid, deadline)
+            if isinstance(value, SerializedObject):
+                return self.ctx.deserialize(value)
+            return value
+        # 2. Borrowed ref: ask the owner where/what the value is.
+        owner_addr = ref.owner_addr()
+        if owner_addr is None or owner_addr == self.addr:
+            # Owned but unknown (e.g. ref survived a restart): try plasma.
+            return self._get_from_plasma(oid, deadline)
+        try:
+            conn = self._owner_conn(owner_addr)
+            resp = conn.call_sync(
+                "get_object", {"oid": oid.binary()}, timeout=self._remaining(deadline)
+            )
+        except rpc.ConnectionLost:
+            raise OwnerDiedError(oid) from None
+        except asyncio.TimeoutError:
+            raise GetTimeoutError(f"object {oid.hex()} not ready within timeout") from None
+        if resp.get("plasma"):
+            return self._get_from_plasma(oid, deadline)
+        if "error" in resp:
+            raise pickle.loads(resp["error"])
+        ser = SerializedObject(resp["value"][0], [memoryview(b) for b in resp["value"][1]])
+        value = self.ctx.deserialize(ser)
+        # Cache small borrowed values for repeat gets.
+        self.memory_store.put(oid, ser)
+        return value
+
+    def _get_from_plasma(self, oid: ObjectID, deadline=None) -> Any:
+        mv = self.plasma.get_mapped(oid, self._remaining(deadline))
+        if mv is None:
+            raise GetTimeoutError(f"object {oid.hex()} not available within timeout")
+        return self.ctx.deserialize(SerializedObject.from_buffer(mv))
+
+    def wait(self, refs: List[ObjectRef], num_returns: int, timeout: Optional[float],
+             fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(refs)
+        ready: List[ObjectRef] = []
+        poll = RayConfig.wait_poll_interval_ms / 1000.0
+        while len(ready) < num_returns:
+            still = []
+            for r in pending:
+                if self._is_ready(r):
+                    ready.append(r)
+                    if len(ready) >= num_returns:
+                        still.extend(pending[pending.index(r) + 1:])
+                        break
+                else:
+                    still.append(r)
+            pending = still
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(poll)
+        return ready, pending
+
+    def _is_ready(self, ref: ObjectRef) -> bool:
+        oid = ref.oid
+        if self.memory_store.contains(oid):
+            return True
+        if self.memory_store.known(oid):
+            return False  # owned, still pending
+        owner_addr = ref.owner_addr()
+        if owner_addr is None or owner_addr == self.addr:
+            return self.plasma.contains(oid)
+        try:
+            st = self._owner_conn(owner_addr).call_sync(
+                "object_status", {"oid": oid.binary()}, timeout=RayConfig.gcs_rpc_timeout_s)
+            return bool(st.get("ready"))
+        except rpc.ConnectionLost:
+            return True  # owner died: get() will raise quickly
+
+    def as_future(self, ref: ObjectRef):
+        return self._get_pool.submit(self._resolve_one, ref, None)
+
+    def free(self, refs: List[ObjectRef]) -> None:
+        for r in refs:
+            self._on_out_of_scope(r.oid)
+
+    # ================================================== ref counting plumbing
+    def register_ref(self, ref: ObjectRef) -> None:
+        self.ref_counter.add_local(ref.oid, ref.owner_addr(), ref.owner_worker_id())
+
+    def deregister_ref(self, ref: ObjectRef) -> None:
+        if self._shut:
+            return
+        self.ref_counter.remove_local(ref.oid)
+        if not self.ref_counter.has(ref.oid):
+            self.plasma.release(ref.oid)
+
+    def _on_out_of_scope(self, oid: ObjectID) -> None:
+        """Owner-side free: reclaim the value everywhere (reference: distributed
+        GC driven by reference_count.cc going to zero)."""
+        self.memory_store.delete(oid)
+        with self._refs_lock:
+            contained = self._contained.pop(oid, None)
+            in_plasma = oid in self._owned_in_plasma
+            self._owned_in_plasma.discard(oid)
+        del contained  # dropping the ObjectRefs decrements their counts
+        if in_plasma and not self._shut:
+            try:
+                self.io.spawn(self.gcs_conn.notify("free_objects", {"oids": [oid.binary()]}))
+            except Exception:
+                pass
+
+    def _notify_owner(self, owner_addr, action: str, oid: ObjectID) -> None:
+        if self._shut:
+            return
+        async def _go():
+            try:
+                conn = await self._owner_conn_async(tuple(owner_addr))
+                await conn.notify("ref_borrow", {
+                    "action": action, "oid": oid.binary(),
+                    "borrower": self.worker_id.binary(),
+                })
+            except (ConnectionError, OSError):
+                pass
+        self.io.spawn(_go())
+
+    def _owner_conn(self, addr: Tuple[str, int]) -> rpc.Connection:
+        conn = self._owner_conns.get(tuple(addr))
+        if conn is None or conn.closed:
+            conn = self.io.run(self._owner_conn_async(tuple(addr)))
+        return conn
+
+    async def _owner_conn_async(self, addr: Tuple[str, int]) -> rpc.Connection:
+        conn = self._owner_conns.get(addr)
+        if conn is None or conn.closed:
+            conn = await rpc.connect(*addr, name=f"->owner-{addr[1]}")
+            self._owner_conns[addr] = conn
+        return conn
+
+    # ============================================== owner-side RPC services
+    async def rpc_get_object(self, conn, msg):
+        """Serve an owned object's value/location to a borrower."""
+        oid = ObjectID(msg["oid"])
+        if not self.memory_store.known(oid):
+            return {"plasma": True}  # not ours or already plasma-only
+        if not self.memory_store.contains(oid):
+            loop = asyncio.get_event_loop()
+            fut = loop.create_future()
+            already = self.memory_store.add_ready_callback(
+                oid, lambda: loop.call_soon_threadsafe(
+                    lambda: fut.done() or fut.set_result(True)))
+            if not already:
+                await fut
+        ok, value, err = self.memory_store.get_if_ready(oid)
+        if err is not None:
+            return {"error": pickle.dumps(err)}
+        if value is IN_PLASMA:
+            return {"plasma": True}
+        if isinstance(value, SerializedObject):
+            return {"value": (value.inband, [bytes(b) for b in value.buffers])}
+        ser = self.ctx.serialize(value)
+        return {"value": (ser.inband, [bytes(b) for b in ser.buffers])}
+
+    async def rpc_object_status(self, conn, msg):
+        oid = ObjectID(msg["oid"])
+        return {"ready": self.memory_store.contains(oid)}
+
+    async def rpc_ref_borrow(self, conn, msg):
+        oid = ObjectID(msg["oid"])
+        if msg["action"] == "add":
+            self.ref_counter.add_borrower(oid, msg["borrower"])
+        else:
+            self.ref_counter.remove_borrower(oid, msg["borrower"])
+        return True
+
+    async def rpc_ping(self, conn, msg):
+        return {"worker_id": self.worker_id.binary(), "pid": os.getpid()}
+
+    async def rpc_exit_worker(self, conn, msg):
+        logger.info("worker exiting on request")
+        os._exit(0)
+
+    # ========================================================= task submission
+    def _function_payload(self, fn) -> Tuple[Optional[bytes], Optional[str]]:
+        blob = cloudpickle.dumps(fn)
+        if len(blob) <= _FUNCTION_TABLE_THRESHOLD:
+            return blob, None
+        key = "fn:" + hashlib.sha1(blob).hexdigest()
+        if key not in self._pushed_fns:
+            self.io.run(self.gcs_conn.call("kv_put", {
+                "ns": "fn", "key": key, "value": blob, "overwrite": False}))
+            self._pushed_fns.add(key)
+        return None, key
+
+    def _build_args(self, args, kwargs) -> Tuple[List[Any], List[str], List[ObjectRef]]:
+        """Serialize call arguments (reference: dependency_resolver.h inlining +
+        plasma promotion of big args)."""
+        out: List[Any] = []
+        holds: List[ObjectRef] = []
+        kw_keys = list(kwargs.keys())
+        for value in list(args) + [kwargs[k] for k in kw_keys]:
+            if isinstance(value, ObjectRef):
+                self.ref_counter.add_submitted(value.oid)
+                holds.append(value)
+                out.append(RefArg(value.oid, value.owner_addr(), value.owner_worker_id()))
+                continue
+            ser = self.ctx.serialize(value)
+            for cref in ser.contained_refs:
+                self.ref_counter.add_submitted(cref.oid)
+                holds.append(cref)
+            if ser.total_bytes() > RayConfig.max_direct_call_object_size:
+                ref = self.put(value)
+                self.ref_counter.add_submitted(ref.oid)
+                holds.append(ref)
+                out.append(RefArg(ref.oid, ref.owner_addr(), ref.owner_worker_id()))
+            else:
+                out.append(InlineArg(ser.inband, [bytes(b) for b in ser.buffers]))
+        return out, kw_keys, holds
+
+    def submit_task(self, fn, args, kwargs, *, name: str, num_returns: int,
+                    resources: Dict[str, float], strategy: SchedulingStrategy,
+                    max_retries: int, retry_exceptions: bool = False,
+                    runtime_env: Optional[dict] = None) -> List[ObjectRef]:
+        blob, key = self._function_payload(fn)
+        spec_args, kw_keys, holds = self._build_args(args, kwargs)
+        task_id = TaskID.for_task(self.job_id)
+        spec = TaskSpec(
+            task_id=task_id, job_id=self.job_id, task_type=TaskType.NORMAL_TASK,
+            name=name, function_blob=blob, function_key=key, args=spec_args,
+            kwargs_keys=kw_keys, num_returns=num_returns, resources=resources,
+            scheduling_strategy=strategy, max_retries=max_retries,
+            retry_exceptions=retry_exceptions,
+            owner_worker_id=self.worker_id.binary(), owner_addr=self.addr,
+            runtime_env=runtime_env,
+        )
+        refs = []
+        for oid in spec.return_ids():
+            self.ref_counter.add_owned(oid, initial_local=0)
+            self.memory_store.register_pending(oid)
+            refs.append(ObjectRef(oid, self.addr, self.worker_id.binary()))
+        self.io.spawn(self.submitter.submit(spec, holds))
+        return refs
+
+    # ------------------------------------------------------------- actors
+    def create_actor(self, cls, args, kwargs, *, name: Optional[str], namespace: Optional[str],
+                     num_returns: int = 0, resources: Dict[str, float],
+                     strategy: SchedulingStrategy, max_restarts: int,
+                     max_task_retries: int, max_concurrency: int,
+                     detached: bool = False, runtime_env: Optional[dict] = None) -> ActorID:
+        blob, key = self._function_payload(cls)
+        spec_args, kw_keys, holds = self._build_args(args, kwargs)
+        actor_id = ActorID.of(self.job_id)
+        task_id = TaskID.for_actor_creation(actor_id)
+        spec = TaskSpec(
+            task_id=task_id, job_id=self.job_id, task_type=TaskType.ACTOR_CREATION_TASK,
+            name=getattr(cls, "__name__", "Actor"), function_blob=blob, function_key=key,
+            args=spec_args, kwargs_keys=kw_keys, num_returns=0, resources=resources,
+            scheduling_strategy=strategy, owner_worker_id=self.worker_id.binary(),
+            owner_addr=self.addr, actor_creation_id=actor_id, max_restarts=max_restarts,
+            max_task_retries=max_task_retries, max_concurrency=max_concurrency,
+            actor_name=name, namespace=namespace if namespace is not None else self.namespace,
+            runtime_env=runtime_env,
+        )
+        self.io.run(self.gcs_conn.call("create_actor", {
+            "spec": pickle.dumps(spec), "detached": detached,
+        }, timeout=RayConfig.gcs_rpc_timeout_s))
+        # holds released once the actor is alive; keep it simple: creation args
+        # stay pinned for the actor's lifetime via the submitter.
+        self._actor_submitter(actor_id).creation_holds = holds
+        return actor_id
+
+    def _actor_submitter(self, actor_id: ActorID) -> "ActorTaskSubmitter":
+        sub = self.actor_submitters.get(actor_id)
+        if sub is None:
+            sub = ActorTaskSubmitter(self, actor_id)
+            self.actor_submitters[actor_id] = sub
+        return sub
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
+                          *, num_returns: int = 1,
+                          max_task_retries: int = 0) -> List[ObjectRef]:
+        spec_args, kw_keys, holds = self._build_args(args, kwargs)
+        task_id = TaskID.for_actor_task(actor_id)
+        spec = TaskSpec(
+            task_id=task_id, job_id=self.job_id, task_type=TaskType.ACTOR_TASK,
+            name=method_name, function_blob=None, function_key=None, args=spec_args,
+            kwargs_keys=kw_keys, num_returns=num_returns, resources={},
+            owner_worker_id=self.worker_id.binary(), owner_addr=self.addr,
+            actor_id=actor_id, actor_method_name=method_name,
+            max_task_retries=max_task_retries,
+        )
+        refs = []
+        for oid in spec.return_ids():
+            self.ref_counter.add_owned(oid, initial_local=0)
+            self.memory_store.register_pending(oid)
+            refs.append(ObjectRef(oid, self.addr, self.worker_id.binary()))
+        self.io.spawn(self._actor_submitter(actor_id).submit(spec, holds))
+        return refs
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
+        self.io.run(self.gcs_conn.call("kill_actor", {
+            "actor_id": actor_id.binary(), "no_restart": no_restart}))
+
+    def get_actor_info(self, actor_id: ActorID, wait_alive=False, timeout=None):
+        return self.io.run(self.gcs_conn.call("get_actor_info", {
+            "actor_id": actor_id.binary(), "wait_alive": wait_alive, "timeout": timeout},
+            timeout=None))
+
+    # ----------------------------------------------- completion bookkeeping
+    def complete_task(self, spec: TaskSpec, returns, holds: List[ObjectRef]):
+        """Record task results into the owner memory store (runs on IO loop)."""
+        for item in returns:
+            oid = ObjectID(item[0])
+            kind = item[1]
+            if kind == "val":
+                self.memory_store.put(oid, SerializedObject(item[2], [memoryview(b) for b in item[3]]))
+            elif kind == "plasma":
+                with self._refs_lock:
+                    self._owned_in_plasma.add(oid)
+                self.memory_store.put(oid, IN_PLASMA)
+            elif kind == "error":
+                err = pickle.loads(item[2])
+                if isinstance(err, RayTaskError):
+                    err = err.as_instanceof_cause()
+                self.memory_store.put(oid, None, error=err)
+        self.release_holds(spec, holds)
+
+    def fail_task(self, spec: TaskSpec, error: BaseException, holds: List[ObjectRef]):
+        for oid in spec.return_ids():
+            self.memory_store.put(oid, None, error=error)
+        self.release_holds(spec, holds)
+
+    def release_holds(self, spec: TaskSpec, holds: List[ObjectRef]):
+        for ref in holds:
+            self.ref_counter.remove_submitted(ref.oid)
+        holds.clear()
+
+    # ============================================================ execution
+    async def _execute_loop(self):
+        """Serialized dispatch: tasks run in arrival order; concurrency bounded
+        by the actor's max_concurrency (reference: actor_scheduling_queue.h)."""
+        while True:
+            item = await self._exec_queue.get()
+            spec, reply_fut = item
+            if self._actor_sem is not None:
+                await self._actor_sem.acquire()
+                asyncio.get_event_loop().create_task(self._run_one(spec, reply_fut, release=True))
+            else:
+                await self._run_one(spec, reply_fut, release=False)
+
+    async def _run_one(self, spec: TaskSpec, reply_fut: asyncio.Future, release: bool):
+        try:
+            result = await self._execute_spec(spec)
+        except BaseException as e:  # never kill the loop
+            result = {"status": "error", "error": pickle.dumps(
+                RayTaskError.from_exception(spec.name, e))}
+        finally:
+            if release and self._actor_sem is not None:
+                self._actor_sem.release()
+        if not reply_fut.done():
+            reply_fut.set_result(result)
+
+    async def rpc_push_task(self, conn, payload):
+        """Execute a task pushed by a submitter or the GCS (actor creation).
+        (reference: CoreWorker::HandlePushTask core_worker.cc:3484)"""
+        spec: TaskSpec = pickle.loads(payload)
+        loop = asyncio.get_event_loop()
+        reply_fut = loop.create_future()
+        await self._exec_queue.put((spec, reply_fut))
+        return await reply_fut
+
+    def _load_function(self, spec: TaskSpec):
+        if spec.function_blob is not None:
+            return cloudpickle.loads(spec.function_blob)
+        key = spec.function_key
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            blob = self.io.run(self.gcs_conn.call("kv_get", {"ns": "fn", "key": key}))
+            if blob is None:
+                raise RaySystemError(f"function {key} missing from GCS function table")
+            fn = cloudpickle.loads(blob)
+            self._fn_cache[key] = fn
+        return fn
+
+    def _resolve_args(self, spec: TaskSpec) -> Tuple[list, dict]:
+        vals = []
+        for a in spec.args:
+            if isinstance(a, InlineArg):
+                vals.append(self.ctx.deserialize(
+                    SerializedObject(a.inband, [memoryview(b) for b in a.buffers])))
+            else:
+                ref = ObjectRef(a.object_id, a.owner_addr, a.owner_worker_id)
+                vals.append(self._resolve_one(ref))
+        n_kw = len(spec.kwargs_keys)
+        if n_kw:
+            pos, kw_vals = vals[:-n_kw], vals[-n_kw:]
+            return pos, dict(zip(spec.kwargs_keys, kw_vals))
+        return vals, {}
+
+    async def _execute_spec(self, spec: TaskSpec) -> dict:
+        loop = asyncio.get_event_loop()
+        if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            return await loop.run_in_executor(self.executor_pool, self._create_actor_sync, spec)
+        if spec.task_type == TaskType.ACTOR_TASK:
+            method = getattr(self.actor_instance, spec.actor_method_name, None)
+            if self.actor_instance is None or method is None:
+                err = RayActorError(spec.actor_id,
+                                    f"actor has no method {spec.actor_method_name!r}"
+                                    if self.actor_instance is not None else "actor not initialized")
+                return {"status": "error", "error": pickle.dumps(err)}
+            if asyncio.iscoroutinefunction(method):
+                return await self._invoke_async(spec, method)
+            return await loop.run_in_executor(
+                self.executor_pool, self._invoke_sync, spec, method)
+        fn = self._load_function(spec)
+        return await loop.run_in_executor(self.executor_pool, self._invoke_sync, spec, fn)
+
+    def _create_actor_sync(self, spec: TaskSpec) -> dict:
+        cls = self._load_function(spec)
+        args, kwargs = self._resolve_args(spec)
+        self.task_ctx.task_id = spec.task_id
+        self.task_ctx.job_id = spec.job_id
+        self.task_ctx.actor_id = spec.actor_creation_id
+        try:
+            self.actor_instance = cls(*args, **kwargs)
+        except BaseException as e:
+            return {"status": "error",
+                    "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
+        self.actor_id = spec.actor_creation_id
+        self.job_id = spec.job_id
+        if spec.max_concurrency > 1 or _has_async_methods(type(self.actor_instance)):
+            # Async actors default to high concurrency (reference: actor.py —
+            # async actors get max_concurrency=1000 unless set explicitly).
+            conc = spec.max_concurrency if spec.max_concurrency > 1 else 1000
+            self._actor_sem = asyncio.Semaphore(conc)
+            self.executor_pool = ThreadPoolExecutor(
+                max_workers=conc, thread_name_prefix="rtpu-actor")
+        return {"status": "ok", "returns": []}
+
+    def _invoke_sync(self, spec: TaskSpec, fn) -> dict:
+        self.task_ctx.task_id = spec.task_id
+        self.task_ctx.job_id = spec.job_id
+        self.task_ctx.task_name = spec.name
+        self.task_ctx.attempt_number = spec.attempt_number
+        if self.job_id.int_value() == 0:
+            self.job_id = spec.job_id
+        try:
+            args, kwargs = self._resolve_args(spec)
+            out = fn(*args, **kwargs)
+            return self._pack_returns(spec, out)
+        except BaseException as e:
+            return {"status": "error",
+                    "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
+        finally:
+            self.task_ctx.task_id = None
+
+    async def _invoke_async(self, spec: TaskSpec, method) -> dict:
+        try:
+            loop = asyncio.get_event_loop()
+            args, kwargs = await loop.run_in_executor(None, self._resolve_args, spec)
+            out = await method(*args, **kwargs)
+            return self._pack_returns(spec, out)
+        except BaseException as e:
+            return {"status": "error",
+                    "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
+
+    def _pack_returns(self, spec: TaskSpec, out) -> dict:
+        if spec.num_returns == 0:
+            return {"status": "ok", "returns": []}
+        if spec.num_returns == 1:
+            outs = [out]
+        else:
+            outs = list(out)
+            if len(outs) != spec.num_returns:
+                raise ValueError(
+                    f"task {spec.name} declared num_returns={spec.num_returns} "
+                    f"but returned {len(outs)} values")
+        returns = []
+        for oid, value in zip(spec.return_ids(), outs):
+            ser = self.ctx.serialize(value)
+            if ser.total_bytes() > RayConfig.max_direct_call_object_size:
+                self.plasma.put(oid, memoryview(ser.to_bytes()))
+                returns.append((oid.binary(), "plasma", ser.total_bytes()))
+            else:
+                returns.append((oid.binary(), "val", ser.inband,
+                                [bytes(b) for b in ser.buffers]))
+        return {"status": "ok", "returns": returns}
+
+
+def _has_async_methods(cls) -> bool:
+    return any(asyncio.iscoroutinefunction(getattr(cls, n, None)) for n in dir(cls)
+               if not n.startswith("__"))
+
+
+def self_addr_key(addr) -> Tuple[str, int]:
+    return tuple(addr)
+
+
+# ============================================================== submitters
+class NormalTaskSubmitter:
+    """Lease-based task submission with worker reuse and spillback
+    (reference: transport/normal_task_submitter.h:75)."""
+
+    def __init__(self, cw: CoreWorker):
+        self.cw = cw
+        self.classes: Dict[tuple, dict] = {}
+        self._pg_node_cache: Dict[bytes, Tuple[float, dict]] = {}
+
+    def _class(self, key) -> dict:
+        st = self.classes.get(key)
+        if st is None:
+            st = self.classes[key] = {
+                "pending": deque(), "idle": [], "inflight": 0, "busy": 0,
+            }
+        return st
+
+    async def submit(self, spec: TaskSpec, holds: List[ObjectRef]):
+        try:
+            await self._resolve_local_deps(spec)
+        except BaseException as e:
+            self.cw.fail_task(spec, RaySystemError(f"dependency resolution failed: {e!r}"), holds)
+            return
+        key = spec.scheduling_class()
+        st = self._class(key)
+        st["pending"].append((spec, holds))
+        await self._pump(key, st)
+
+    async def _resolve_local_deps(self, spec: TaskSpec):
+        """Wait for owned pending deps; inline those that resolved small
+        (reference: LocalDependencyResolver)."""
+        loop = asyncio.get_event_loop()
+        for i, a in enumerate(spec.args):
+            if not isinstance(a, RefArg):
+                continue
+            if a.owner_worker_id != self.cw.worker_id.binary():
+                continue
+            ms = self.cw.memory_store
+            if not ms.known(a.object_id):
+                continue
+            if not ms.contains(a.object_id):
+                fut = loop.create_future()
+                already = ms.add_ready_callback(
+                    a.object_id,
+                    lambda: loop.call_soon_threadsafe(
+                        lambda: fut.done() or fut.set_result(True)))
+                if not already:
+                    await fut
+            ok, value, err = ms.get_if_ready(a.object_id)
+            if err is not None:
+                raise err
+            if isinstance(value, SerializedObject) and not value.contained_refs:
+                spec.args[i] = InlineArg(value.inband, [bytes(b) for b in value.buffers])
+
+    async def _pump(self, key, st):
+        while st["pending"] and st["idle"]:
+            spec, holds = st["pending"].popleft()
+            lease = st["idle"].pop()
+            asyncio.get_event_loop().create_task(
+                self._push_one(key, st, spec, holds, lease))
+        max_pending = RayConfig.max_pending_lease_requests_per_scheduling_category
+        want = min(len(st["pending"]), max_pending) - st["inflight"]
+        for _ in range(max(want, 0)):
+            st["inflight"] += 1
+            asyncio.get_event_loop().create_task(self._request_lease(key, st))
+        if not st["pending"] and not st["busy"]:
+            await self._return_idle(st)
+
+    async def _return_idle(self, st):
+        while st["idle"]:
+            lease = st["idle"].pop()
+            try:
+                await lease["nodelet_conn"].call("return_worker", {"lease_id": lease["lease_id"]})
+            except (ConnectionError, asyncio.TimeoutError):
+                pass
+
+    async def _lease_target(self, spec: TaskSpec) -> rpc.Connection:
+        s = spec.scheduling_strategy
+        if s.kind == "placement_group" and s.placement_group_id is not None:
+            node = await self._bundle_node(s.placement_group_id, s.placement_group_bundle_index)
+            if node is not None:
+                return await self._nodelet_conn(tuple(node["addr"]))
+        elif s.kind == "node_affinity" and s.node_id is not None:
+            view = await self.cw.gcs_conn.call("get_cluster_view", None)
+            for n in view:
+                if n["node_id"] == s.node_id and n["alive"]:
+                    return await self._nodelet_conn(tuple(n["addr"]))
+            if not s.soft:
+                raise RaySystemError("node affinity target is not alive")
+        return self.cw.nodelet_conn
+
+    async def _bundle_node(self, pg_id, index) -> Optional[dict]:
+        info = await self.cw.gcs_conn.call("get_placement_group", {"pg_id": pg_id.binary()})
+        if info is None or info["state"] != "CREATED":
+            # Wait for the PG to be ready (tasks targeting a PG queue on it).
+            await self.cw.gcs_conn.call("wait_placement_group_ready",
+                                        {"pg_id": pg_id.binary(), "timeout": 60})
+            info = await self.cw.gcs_conn.call("get_placement_group", {"pg_id": pg_id.binary()})
+            if info is None:
+                return None
+        idx = index if index >= 0 else 0
+        nodes = info["bundle_nodes"]
+        if idx >= len(nodes) or nodes[idx] is None:
+            return None
+        view = await self.cw.gcs_conn.call("get_cluster_view", None)
+        for n in view:
+            if n["node_id"] == nodes[idx]:
+                return n
+        return None
+
+    async def _nodelet_conn(self, addr) -> rpc.Connection:
+        conn = self.cw._nodelet_conns.get(tuple(addr))
+        if conn is None or conn.closed:
+            conn = await rpc.connect(*addr, name=f"->nodelet-{addr[1]}")
+            self.cw._nodelet_conns[tuple(addr)] = conn
+        return conn
+
+    async def _request_lease(self, key, st):
+        try:
+            if not st["pending"]:
+                return
+            spec, _ = st["pending"][0]
+            s = spec.scheduling_strategy
+            bundle = None
+            if s.kind == "placement_group" and s.placement_group_id is not None:
+                bundle = (s.placement_group_id.binary(),
+                          max(s.placement_group_bundle_index, 0))
+            conn = await self._lease_target(spec)
+            msg = {"resources": spec.resources,
+                   "strategy": {"kind": s.kind, "node_id": s.node_id, "soft": s.soft},
+                   "bundle": bundle, "spillback_count": 0}
+            for _ in range(8):  # bounded spillback chain
+                resp = await conn.call("request_worker_lease", msg, timeout=None)
+                if resp["type"] == "granted":
+                    worker_conn = await self._worker_conn(tuple(resp["worker_addr"]))
+                    lease = {"lease_id": resp["lease_id"], "worker_conn": worker_conn,
+                             "worker_addr": tuple(resp["worker_addr"]),
+                             "worker_id": resp["worker_id"], "nodelet_conn": conn}
+                    st["idle"].append(lease)
+                    await self._pump(key, st)
+                    return
+                if resp["type"] == "spillback":
+                    conn = await self._nodelet_conn(tuple(resp["node_addr"]))
+                    msg["spillback_count"] += 1
+                    continue
+                # infeasible
+                err = RaySystemError(
+                    f"cannot schedule task: {resp.get('reason', 'infeasible resources')}")
+                while st["pending"]:
+                    sp, holds = st["pending"].popleft()
+                    self.cw.fail_task(sp, err, holds)
+                return
+        except (ConnectionError, asyncio.TimeoutError) as e:
+            if not self.cw._shut:
+                logger.warning("lease request failed: %r", e)
+                await asyncio.sleep(0.2)
+        finally:
+            st["inflight"] -= 1
+
+    async def _worker_conn(self, addr) -> rpc.Connection:
+        conn = self.cw._worker_conns.get(tuple(addr))
+        if conn is None or conn.closed:
+            conn = await rpc.connect(*addr, name=f"->worker-{addr[1]}")
+            self.cw._worker_conns[tuple(addr)] = conn
+        return conn
+
+    async def _push_one(self, key, st, spec: TaskSpec, holds, lease):
+        st["busy"] += 1
+        worker_ok = True
+        try:
+            reply = await lease["worker_conn"].call("push_task", pickle.dumps(spec), timeout=None)
+            if reply["status"] == "ok":
+                self.cw.complete_task(spec, reply["returns"], holds)
+            else:
+                err = pickle.loads(reply["error"])
+                if spec.retry_exceptions and spec.attempt_number < spec.max_retries:
+                    spec.attempt_number += 1
+                    st["pending"].append((spec, holds))
+                else:
+                    self.cw.complete_task(
+                        spec, [(oid.binary(), "error", reply["error"])
+                               for oid in spec.return_ids()], holds)
+        except (rpc.ConnectionLost, ConnectionError) as e:
+            worker_ok = False
+            if spec.attempt_number < spec.max_retries:
+                spec.attempt_number += 1
+                logger.info("retrying task %s (attempt %d) after worker failure",
+                            spec.name, spec.attempt_number)
+                st["pending"].append((spec, holds))
+            else:
+                self.cw.fail_task(spec, WorkerCrashedError(
+                    f"worker died while running task {spec.name}: {e}"), holds)
+        finally:
+            st["busy"] -= 1
+            if worker_ok:
+                st["idle"].append(lease)
+            await self._pump(key, st)
+
+
+class ActorTaskSubmitter:
+    """Direct actor-task submission over one persistent connection
+    (reference: transport/actor_task_submitter.h:73).  Ordering: one TCP stream +
+    in-order dispatch on the actor side replaces explicit sequence numbers for
+    the common path; retries after restart re-enter the queue in order."""
+
+    def __init__(self, cw: CoreWorker, actor_id: ActorID):
+        self.cw = cw
+        self.actor_id = actor_id
+        self.conn: Optional[rpc.Connection] = None
+        self.state = "PENDING"
+        self.death_cause = ""
+        self.creation_holds: List[ObjectRef] = []
+        self._connect_lock = asyncio.Lock()
+        self._subscribed = False
+        self._inflight: Dict[bytes, Tuple[TaskSpec, list]] = {}
+
+    def _on_actor_update(self, info):
+        self.state = info["state"]
+        if info["state"] == "DEAD":
+            self.death_cause = info.get("death_cause", "")
+            err = ActorDiedError(self.actor_id, _actor_death_msg(self.actor_id, self.death_cause))
+            for task_key in list(self._inflight):
+                spec, holds = self._inflight.pop(task_key)
+                self.cw.fail_task(spec, err, holds)
+            self.conn = None
+        elif info["state"] in ("RESTARTING",):
+            self.conn = None
+
+    async def _ensure_connected(self):
+        async with self._connect_lock:
+            if not self._subscribed:
+                self._subscribed = True
+                self.cw._subscriptions.setdefault(
+                    f"actor:{self.actor_id.hex()}", []).append(self._on_actor_update)
+                await self.cw.gcs_conn.call(
+                    "subscribe", {"channel": f"actor:{self.actor_id.hex()}"})
+            if self.conn is not None and not self.conn.closed:
+                return
+            deadline = time.monotonic() + RayConfig.gcs_rpc_timeout_s * 2
+            while True:
+                info = await self.cw.gcs_conn.call("get_actor_info", {
+                    "actor_id": self.actor_id.binary(), "wait_alive": True,
+                    "timeout": 10.0}, timeout=None)
+                if info is None:
+                    raise RayActorError(self.actor_id, "actor not found")
+                self.state = info["state"]
+                if info["state"] == "DEAD":
+                    raise ActorDiedError(
+                        self.actor_id, _actor_death_msg(self.actor_id, info.get("death_cause", "")))
+                if info["state"] == "ALIVE" and info["addr"]:
+                    self.conn = await rpc.connect(
+                        *info["addr"], name=f"->actor-{self.actor_id.hex()[:6]}")
+                    return
+                if time.monotonic() > deadline:
+                    raise RayActorError(self.actor_id, "timed out waiting for actor to start")
+
+    async def submit(self, spec: TaskSpec, holds):
+        tkey = spec.task_id.binary()
+        try:
+            await self._ensure_connected()
+            self._inflight[tkey] = (spec, holds)
+            reply = await self.conn.call("push_task", pickle.dumps(spec), timeout=None)
+            if tkey not in self._inflight:
+                return  # already failed via death notification
+            del self._inflight[tkey]
+            if reply["status"] == "ok":
+                self.cw.complete_task(spec, reply["returns"], holds)
+            else:
+                self.cw.complete_task(
+                    spec, [(oid.binary(), "error", reply["error"])
+                           for oid in spec.return_ids()], holds)
+        except (rpc.ConnectionLost, ConnectionError):
+            self._inflight.pop(tkey, None)
+            self.conn = None
+            if spec.max_task_retries != 0 and spec.attempt_number < max(spec.max_task_retries, 0):
+                spec.attempt_number += 1
+                await self.submit(spec, holds)
+            else:
+                self.cw.fail_task(spec, ActorDiedError(
+                    self.actor_id,
+                    f"actor {self.actor_id.hex()[:8]} died while running {spec.name}"),
+                    holds)
+        except (RayActorError, ActorDiedError) as e:
+            self._inflight.pop(tkey, None)
+            self.cw.fail_task(spec, e, holds)
+
+
+def _actor_death_msg(actor_id: ActorID, cause: str) -> str:
+    return f"actor {actor_id.hex()[:8]} is dead: {cause or 'unknown cause'}"

@@ -1,0 +1,605 @@
+"""GCS server: the head-node control plane.
+
+Counterpart of the reference's GCS (reference: src/ray/gcs/gcs_server/gcs_server.h:78)
+with its managers condensed into one asyncio process:
+
+- node directory + health checking      (GcsNodeManager, gcs_node_manager.h:44;
+                                         GcsHealthCheckManager, gcs_health_check_manager.h:39)
+- actor directory + scheduling/restart  (GcsActorManager, gcs_actor_manager.h:278;
+                                         GcsActorScheduler ScheduleByGcs, gcs_actor_scheduler.cc:60)
+- placement groups                      (GcsPlacementGroupManager/Scheduler)
+- internal KV                           (gcs_kv_manager.h; used for the function table,
+                                         cluster metadata, named config)
+- cluster resource aggregation + view   (GcsResourceManager + ray_syncer broadcast,
+                                         ray_syncer.proto:62 — here: pubsub pushes)
+- object directory                      (the owner/location table the reference keeps in
+                                         OwnershipBasedObjectDirectory; centralized here)
+- pub/sub broker                        (src/ray/pubsub/ — here: push over the persistent
+                                         bidirectional RPC connections, no long-polling)
+- job manager                           (gcs_job_manager.h:41)
+- task events sink                      (GcsTaskManager, gcs_task_manager.h:86)
+
+Liveness: each nodelet keeps one persistent RPC connection; TCP teardown marks the
+node dead immediately, and a periodic ping catches hangs (the reference health-checks
+over gRPC on a timer).  Storage is in-memory (the reference's default StoreClient);
+a pluggable store seam exists for persistence (store_client.h:33 equivalent).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import RayConfig
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, PlacementGroupID
+from ray_tpu._private.task_spec import TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+class NodeInfo:
+    __slots__ = ("node_id", "addr", "resources_total", "resources_available",
+                 "labels", "conn", "alive", "last_seen", "start_time", "node_name",
+                 "object_store_capacity", "death_cause")
+
+    def __init__(self, node_id: NodeID, addr: Tuple[str, int], resources_total: Dict[str, float],
+                 labels: Dict[str, str], conn: rpc.Connection, node_name: str = ""):
+        self.node_id = node_id
+        self.addr = addr
+        self.resources_total = dict(resources_total)
+        self.resources_available = dict(resources_total)
+        self.labels = labels
+        self.conn = conn
+        self.alive = True
+        self.last_seen = time.monotonic()
+        self.start_time = time.time()
+        self.node_name = node_name
+        self.object_store_capacity = 0
+        self.death_cause = ""
+
+    def view(self) -> dict:
+        return {
+            "node_id": self.node_id.binary(),
+            "addr": self.addr,
+            "total": self.resources_total,
+            "available": self.resources_available,
+            "labels": self.labels,
+            "alive": self.alive,
+            "node_name": self.node_name,
+            "start_time": self.start_time,
+        }
+
+
+class ActorInfo:
+    __slots__ = ("actor_id", "spec", "state", "addr", "worker_id", "node_id", "name",
+                 "namespace", "num_restarts", "max_restarts", "death_cause", "pending_waiters",
+                 "class_name", "job_id", "start_time", "detached", "creation_conn")
+
+    def __init__(self, actor_id: ActorID, spec: bytes, name: Optional[str], namespace: str,
+                 max_restarts: int, class_name: str, job_id: bytes, detached: bool):
+        self.actor_id = actor_id
+        self.spec = spec  # pickled ACTOR_CREATION TaskSpec
+        self.state = "PENDING_CREATION"  # -> ALIVE -> RESTARTING/DEAD
+        self.addr: Optional[Tuple[str, int]] = None
+        self.worker_id: Optional[bytes] = None
+        self.node_id: Optional[bytes] = None
+        self.name = name
+        self.namespace = namespace
+        self.num_restarts = 0
+        self.max_restarts = max_restarts
+        self.death_cause = ""
+        self.pending_waiters: List[asyncio.Future] = []
+        self.class_name = class_name
+        self.job_id = job_id
+        self.start_time = time.time()
+        self.detached = detached
+
+    def public_info(self) -> dict:
+        return {
+            "actor_id": self.actor_id.binary(),
+            "state": self.state,
+            "addr": self.addr,
+            "worker_id": self.worker_id,
+            "node_id": self.node_id,
+            "name": self.name,
+            "namespace": self.namespace,
+            "class_name": self.class_name,
+            "num_restarts": self.num_restarts,
+            "death_cause": self.death_cause,
+            "job_id": self.job_id,
+            "start_time": self.start_time,
+        }
+
+
+class GcsServer:
+    def __init__(self, node_for_bundle=None):
+        self.nodes: Dict[NodeID, NodeInfo] = {}
+        self.actors: Dict[ActorID, ActorInfo] = {}
+        self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace, name)
+        self.kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> {key: value}
+        self.object_dir: Dict[bytes, Set[bytes]] = {}  # oid binary -> {node_id binary}
+        self.subscribers: Dict[str, Set[rpc.Connection]] = {}  # channel -> conns
+        self.next_job = 1
+        self.jobs: Dict[bytes, dict] = {}
+        self.placement_groups: Dict[PlacementGroupID, Any] = {}  # filled by pg_manager
+        self.task_events: deque = deque(maxlen=RayConfig.task_events_max_buffer_size)
+        self.server = rpc.Server(self._handlers(), name="gcs")
+        self.server.on_disconnect = self._on_disconnect
+        self._started = asyncio.Event()
+        self.addr: Tuple[str, int] = ("", 0)
+        self.cluster_id = NodeID.from_random().hex()
+        self._bg: List[asyncio.Task] = []
+        from ray_tpu._private.gcs.pg_manager import PlacementGroupManager
+
+        self.pg_manager = PlacementGroupManager(self)
+
+    # ------------------------------------------------------------------ setup
+    def _handlers(self) -> dict:
+        h = {}
+        for name in dir(self):
+            if name.startswith("rpc_"):
+                h[name[4:]] = getattr(self, name)
+        return h
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
+        self.addr = await self.server.start(host, port)
+        self._bg.append(asyncio.get_event_loop().create_task(self._health_check_loop()))
+        self._started.set()
+        logger.info("GCS listening on %s:%s", *self.addr)
+        return self.addr
+
+    async def stop(self):
+        for t in self._bg:
+            t.cancel()
+        await self.server.stop()
+
+    # ------------------------------------------------------------ liveness
+    def _on_disconnect(self, conn: rpc.Connection):
+        node_id = conn.context.get("node_id")
+        if node_id is not None:
+            loop = asyncio.get_event_loop()
+            loop.create_task(self._mark_node_dead(NodeID(node_id), "nodelet connection lost"))
+
+    async def _health_check_loop(self):
+        interval = RayConfig.heartbeat_interval_ms / 1000.0
+        timeout = RayConfig.health_check_timeout_ms / 1000.0
+        while True:
+            await asyncio.sleep(interval * 4)
+            now = time.monotonic()
+            for info in list(self.nodes.values()):
+                if not info.alive:
+                    continue
+                if now - info.last_seen > timeout:
+                    await self._mark_node_dead(info.node_id, "health check timed out")
+
+    async def _mark_node_dead(self, node_id: NodeID, reason: str):
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        info.death_cause = reason
+        logger.warning("node %s marked dead: %s", node_id.hex()[:8], reason)
+        # Drop object locations on that node.
+        nid = node_id.binary()
+        for oid, locs in list(self.object_dir.items()):
+            locs.discard(nid)
+            if not locs:
+                del self.object_dir[oid]
+        await self.publish("node", {"event": "dead", "node": info.view()})
+        # Fail/restart actors that lived there.
+        for actor in list(self.actors.values()):
+            if actor.node_id == nid and actor.state in ("ALIVE", "PENDING_CREATION", "RESTARTING"):
+                await self._handle_actor_failure(actor, f"node died: {reason}")
+        self.pg_manager.on_node_dead(node_id)
+
+    # ------------------------------------------------------------- pub/sub
+    async def publish(self, channel: str, data: Any):
+        dead = []
+        for conn in self.subscribers.get(channel, ()):  # push, no long-poll
+            try:
+                await conn.notify("publish", {"channel": channel, "data": data})
+            except ConnectionError:
+                dead.append(conn)
+        for c in dead:
+            self.subscribers.get(channel, set()).discard(c)
+
+    async def rpc_subscribe(self, conn, msg):
+        self.subscribers.setdefault(msg["channel"], set()).add(conn)
+        return True
+
+    async def rpc_unsubscribe(self, conn, msg):
+        self.subscribers.get(msg["channel"], set()).discard(conn)
+        return True
+
+    # --------------------------------------------------------------- nodes
+    async def rpc_register_node(self, conn, msg):
+        node_id = NodeID(msg["node_id"])
+        info = NodeInfo(
+            node_id, tuple(msg["addr"]), msg["resources"], msg.get("labels", {}),
+            conn, node_name=msg.get("node_name", ""),
+        )
+        info.object_store_capacity = msg.get("object_store_capacity", 0)
+        self.nodes[node_id] = info
+        conn.context["node_id"] = node_id.binary()
+        await self.publish("node", {"event": "added", "node": info.view()})
+        return {"cluster_id": self.cluster_id, "cluster_view": self.cluster_view()}
+
+    async def rpc_resource_report(self, conn, msg):
+        node_id = NodeID(msg["node_id"])
+        info = self.nodes.get(node_id)
+        if info is None or not info.alive:
+            return {"dead": True}
+        info.last_seen = time.monotonic()
+        info.resources_available = msg["available"]
+        if msg.get("total"):
+            info.resources_total = msg["total"]
+        # Broadcast the delta so every nodelet's cluster view converges
+        # (reference: ray_syncer resource-view stream).
+        await self.publish("resource_view", {
+            "node_id": msg["node_id"],
+            "available": msg["available"],
+            "total": info.resources_total,
+        })
+        return {"dead": False}
+
+    async def rpc_get_cluster_view(self, conn, msg):
+        return self.cluster_view()
+
+    def cluster_view(self) -> list:
+        return [n.view() for n in self.nodes.values()]
+
+    async def rpc_get_all_node_info(self, conn, msg):
+        return [n.view() for n in self.nodes.values()]
+
+    async def rpc_drain_node(self, conn, msg):
+        await self._mark_node_dead(NodeID(msg["node_id"]), msg.get("reason", "drained"))
+        return True
+
+    async def rpc_check_alive(self, conn, msg):
+        return {"alive": True, "cluster_id": self.cluster_id}
+
+    # ----------------------------------------------------------------- jobs
+    async def rpc_register_job(self, conn, msg):
+        job_id = JobID.from_int(self.next_job)
+        self.next_job += 1
+        self.jobs[job_id.binary()] = {
+            "job_id": job_id.binary(),
+            "driver_addr": msg.get("driver_addr"),
+            "start_time": time.time(),
+            "status": "RUNNING",
+            "entrypoint": msg.get("entrypoint", ""),
+            "metadata": msg.get("metadata", {}),
+        }
+        conn.context["job_id"] = job_id.binary()
+        return {"job_id": job_id.binary()}
+
+    async def rpc_mark_job_finished(self, conn, msg):
+        j = self.jobs.get(msg["job_id"])
+        if j:
+            j["status"] = msg.get("status", "SUCCEEDED")
+            j["end_time"] = time.time()
+        return True
+
+    async def rpc_get_all_job_info(self, conn, msg):
+        return list(self.jobs.values())
+
+    # ------------------------------------------------------------------- kv
+    async def rpc_kv_put(self, conn, msg):
+        ns = self.kv.setdefault(msg.get("ns", ""), {})
+        existed = msg["key"] in ns
+        if msg.get("overwrite", True) or not existed:
+            ns[msg["key"]] = msg["value"]
+        return existed
+
+    async def rpc_kv_get(self, conn, msg):
+        return self.kv.get(msg.get("ns", ""), {}).get(msg["key"])
+
+    async def rpc_kv_multi_get(self, conn, msg):
+        ns = self.kv.get(msg.get("ns", ""), {})
+        return {k: ns[k] for k in msg["keys"] if k in ns}
+
+    async def rpc_kv_del(self, conn, msg):
+        ns = self.kv.get(msg.get("ns", ""), {})
+        if msg.get("prefix"):
+            doomed = [k for k in ns if k.startswith(msg["key"])]
+            for k in doomed:
+                del ns[k]
+            return len(doomed)
+        return 1 if ns.pop(msg["key"], None) is not None else 0
+
+    async def rpc_kv_keys(self, conn, msg):
+        ns = self.kv.get(msg.get("ns", ""), {})
+        prefix = msg.get("prefix", "")
+        return [k for k in ns if k.startswith(prefix)]
+
+    async def rpc_kv_exists(self, conn, msg):
+        return msg["key"] in self.kv.get(msg.get("ns", ""), {})
+
+    # ------------------------------------------------------- object directory
+    async def rpc_object_locations_added(self, conn, msg):
+        # Batched {node_id, oids: [bytes]} from nodelets on seal.
+        nid = msg["node_id"]
+        for ob in msg["oids"]:
+            self.object_dir.setdefault(ob, set()).add(nid)
+        return True
+
+    async def rpc_object_locations_removed(self, conn, msg):
+        nid = msg["node_id"]
+        for ob in msg["oids"]:
+            locs = self.object_dir.get(ob)
+            if locs is not None:
+                locs.discard(nid)
+                if not locs:
+                    del self.object_dir[ob]
+        return True
+
+    async def rpc_get_object_locations(self, conn, msg):
+        out = {}
+        for ob in msg["oids"]:
+            locs = self.object_dir.get(ob, set())
+            out[ob] = [
+                self.nodes[NodeID(n)].addr for n in locs
+                if NodeID(n) in self.nodes and self.nodes[NodeID(n)].alive
+            ]
+        return out
+
+    async def rpc_free_objects(self, conn, msg):
+        """Owner-driven free: delete every copy cluster-wide (distributed GC)."""
+        by_node: Dict[bytes, List[bytes]] = {}
+        for ob in msg["oids"]:
+            for nid in self.object_dir.pop(ob, set()):
+                by_node.setdefault(nid, []).append(ob)
+        for nid, obs in by_node.items():
+            info = self.nodes.get(NodeID(nid))
+            if info and info.alive:
+                try:
+                    await info.conn.notify("free_local_objects", {"oids": obs})
+                except ConnectionError:
+                    pass
+        return True
+
+    # ---------------------------------------------------------------- actors
+    def _pick_node_for(self, resources: Dict[str, float]) -> Optional[NodeInfo]:
+        """GCS-side actor placement (reference: GcsActorScheduler::ScheduleByGcs,
+        gcs_actor_scheduler.cc:60) — least-loaded feasible node."""
+        best, best_score = None, None
+        for info in self.nodes.values():
+            if not info.alive:
+                continue
+            if any(info.resources_total.get(k, 0.0) < v for k, v in resources.items() if v > 0):
+                continue
+            if any(info.resources_available.get(k, 0.0) < v for k, v in resources.items() if v > 0):
+                continue
+            # LeastResourceScorer-style: prefer the node with most headroom.
+            score = sum(info.resources_available.get(k, 0.0) for k in ("CPU",))
+            if best_score is None or score > best_score:
+                best, best_score = info, score
+        return best
+
+    async def rpc_create_actor(self, conn, msg):
+        import pickle
+
+        spec: TaskSpec = pickle.loads(msg["spec"])
+        actor_id = spec.actor_creation_id
+        name = spec.actor_name
+        namespace = spec.namespace or ""
+        if name:
+            key = (namespace, name)
+            if key in self.named_actors:
+                existing = self.actors.get(self.named_actors[key])
+                if existing is not None and existing.state != "DEAD":
+                    raise ValueError(f"actor name {name!r} already taken in namespace {namespace!r}")
+            self.named_actors[key] = actor_id
+        info = ActorInfo(
+            actor_id, msg["spec"], name, namespace, spec.max_restarts,
+            class_name=spec.name, job_id=spec.job_id.binary(), detached=bool(msg.get("detached")),
+        )
+        self.actors[actor_id] = info
+        asyncio.get_event_loop().create_task(self._schedule_actor(info))
+        return {"actor_id": actor_id.binary()}
+
+    async def _schedule_actor(self, info: ActorInfo):
+        import pickle
+
+        spec: TaskSpec = pickle.loads(info.spec)
+        deadline = time.monotonic() + 60.0
+        while True:
+            # Placement-group bundles pin the actor to the bundle's node.
+            target = None
+            s = spec.scheduling_strategy
+            if s.kind == "placement_group" and s.placement_group_id is not None:
+                node_id = self.pg_manager.node_for_bundle(
+                    s.placement_group_id, s.placement_group_bundle_index
+                )
+                if node_id is not None:
+                    target = self.nodes.get(NodeID(node_id))
+                    if target is not None and not target.alive:
+                        target = None
+            elif s.kind == "node_affinity" and s.node_id is not None:
+                target = self.nodes.get(NodeID(s.node_id))
+                if target is not None and (not target.alive):
+                    target = None
+                if target is None and not s.soft:
+                    info.state = "DEAD"
+                    info.death_cause = "node affinity target is dead"
+                    await self._publish_actor(info)
+                    return
+            if target is None:
+                target = self._pick_node_for(spec.resources)
+            if target is not None:
+                try:
+                    resp = await target.conn.call(
+                        "lease_worker_for_actor",
+                        {"spec": info.spec,
+                         "bundle": (s.placement_group_id.binary(), s.placement_group_bundle_index)
+                         if s.kind == "placement_group" and s.placement_group_id else None},
+                        timeout=RayConfig.gcs_rpc_timeout_s,
+                    )
+                except (ConnectionError, asyncio.TimeoutError):
+                    resp = None
+                if resp and resp.get("ok"):
+                    info.state = "ALIVE"
+                    info.addr = tuple(resp["worker_addr"])
+                    info.worker_id = resp["worker_id"]
+                    info.node_id = target.node_id.binary()
+                    await self._publish_actor(info)
+                    for fut in info.pending_waiters:
+                        if not fut.done():
+                            fut.set_result(True)
+                    info.pending_waiters.clear()
+                    return
+            if time.monotonic() > deadline:
+                info.state = "DEAD"
+                info.death_cause = f"could not schedule actor: no feasible node for {spec.resources}"
+                await self._publish_actor(info)
+                return
+            await asyncio.sleep(0.2)
+
+    async def _publish_actor(self, info: ActorInfo):
+        await self.publish("actor", info.public_info())
+        await self.publish(f"actor:{info.actor_id.hex()}", info.public_info())
+
+    async def _handle_actor_failure(self, info: ActorInfo, reason: str):
+        if info.state == "DEAD":
+            return
+        if info.num_restarts < info.max_restarts or info.max_restarts < 0:
+            info.num_restarts += 1
+            info.state = "RESTARTING"
+            info.addr = None
+            await self._publish_actor(info)
+            asyncio.get_event_loop().create_task(self._schedule_actor(info))
+        else:
+            info.state = "DEAD"
+            info.death_cause = reason
+            await self._publish_actor(info)
+            if info.name:
+                self.named_actors.pop((info.namespace, info.name), None)
+
+    async def rpc_worker_died(self, conn, msg):
+        """Nodelet reports a worker process exit; fail any actor bound to it."""
+        wid = msg["worker_id"]
+        for info in list(self.actors.values()):
+            if info.worker_id == wid and info.state in ("ALIVE", "PENDING_CREATION"):
+                await self._handle_actor_failure(
+                    info, msg.get("reason", "the worker process died")
+                )
+        return True
+
+    async def rpc_get_actor_info(self, conn, msg):
+        actor_id = ActorID(msg["actor_id"])
+        info = self.actors.get(actor_id)
+        if info is None:
+            return None
+        if msg.get("wait_alive") and info.state in ("PENDING_CREATION", "RESTARTING"):
+            fut = asyncio.get_event_loop().create_future()
+            info.pending_waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, msg.get("timeout", RayConfig.gcs_rpc_timeout_s))
+            except asyncio.TimeoutError:
+                pass
+        return info.public_info()
+
+    async def rpc_get_named_actor(self, conn, msg):
+        actor_id = self.named_actors.get((msg.get("namespace", ""), msg["name"]))
+        if actor_id is None:
+            return None
+        info = self.actors.get(actor_id)
+        return info.public_info() if info and info.state != "DEAD" else None
+
+    async def rpc_list_named_actors(self, conn, msg):
+        ns = msg.get("namespace")
+        out = []
+        for (namespace, name), aid in self.named_actors.items():
+            info = self.actors.get(aid)
+            if info is None or info.state == "DEAD":
+                continue
+            if ns is None or ns == namespace:
+                out.append({"name": name, "namespace": namespace})
+        return out
+
+    async def rpc_kill_actor(self, conn, msg):
+        actor_id = ActorID(msg["actor_id"])
+        info = self.actors.get(actor_id)
+        if info is None:
+            return False
+        no_restart = msg.get("no_restart", True)
+        if no_restart:
+            info.max_restarts = info.num_restarts  # exhaust restarts
+        if info.node_id is not None:
+            node = self.nodes.get(NodeID(info.node_id))
+            if node and node.alive and info.worker_id:
+                try:
+                    await node.conn.call("kill_worker", {"worker_id": info.worker_id})
+                except ConnectionError:
+                    pass
+        await self._handle_actor_failure(info, "killed via ray.kill" if no_restart else "actor restart requested")
+        return True
+
+    async def rpc_get_all_actor_info(self, conn, msg):
+        return [a.public_info() for a in self.actors.values()]
+
+    # ------------------------------------------------------ placement groups
+    async def rpc_create_placement_group(self, conn, msg):
+        return await self.pg_manager.create(msg)
+
+    async def rpc_remove_placement_group(self, conn, msg):
+        return await self.pg_manager.remove(PlacementGroupID(msg["pg_id"]))
+
+    async def rpc_wait_placement_group_ready(self, conn, msg):
+        return await self.pg_manager.wait_ready(PlacementGroupID(msg["pg_id"]), msg.get("timeout"))
+
+    async def rpc_get_placement_group(self, conn, msg):
+        return self.pg_manager.get_info(PlacementGroupID(msg["pg_id"]))
+
+    async def rpc_get_all_placement_group_info(self, conn, msg):
+        return self.pg_manager.list_info()
+
+    # ------------------------------------------------------------ task events
+    async def rpc_add_task_events(self, conn, msg):
+        self.task_events.extend(msg["events"])
+        return True
+
+    async def rpc_get_task_events(self, conn, msg):
+        limit = msg.get("limit", 1000)
+        job = msg.get("job_id")
+        out = []
+        for ev in reversed(self.task_events):
+            if job is not None and ev.get("job_id") != job:
+                continue
+            out.append(ev)
+            if len(out) >= limit:
+                break
+        return out
+
+
+def main(argv=None):
+    """Entry point for the gcs_server process (reference: gcs_server_main.cc)."""
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO, format="[gcs] %(levelname)s %(message)s")
+
+    async def run():
+        server = GcsServer()
+        host, port = await server.start(args.host, args.port)
+        # Parent discovers the bound port from this line.
+        print(f"GCS_PORT {port}", flush=True)
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""ObjectRef: a handle to a (possibly pending) remote value.
+
+Counterpart of the reference's ObjectRef (reference: python/ray/_raylet.pyx
+ObjectRef; ownership fields from reference_count.h).  The ref embeds its owner's
+address so any process holding it can resolve the value and participate in the
+borrower protocol.  ``__del__`` drives distributed GC; ``__reduce__`` records the
+ref with the in-flight serialization so the owner learns about borrowers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.serialization import record_contained_ref
+
+
+class ObjectRef:
+    __slots__ = ("_oid", "_owner_addr", "_owner_worker_id", "_registered", "__weakref__")
+
+    def __init__(self, oid: ObjectID, owner_addr: Optional[Tuple[str, int]] = None,
+                 owner_worker_id: Optional[bytes] = None, _register: bool = True):
+        self._oid = oid
+        self._owner_addr = tuple(owner_addr) if owner_addr else None
+        self._owner_worker_id = owner_worker_id
+        self._registered = False
+        if _register:
+            from ray_tpu._private import worker as worker_mod
+
+            cw = worker_mod.global_worker_core()
+            if cw is not None:
+                cw.register_ref(self)
+                self._registered = True
+
+    # -- identity -------------------------------------------------------------
+    @property
+    def oid(self) -> ObjectID:
+        return self._oid
+
+    def binary(self) -> bytes:
+        return self._oid.binary()
+
+    def hex(self) -> str:
+        return self._oid.hex()
+
+    def owner_addr(self):
+        return self._owner_addr
+
+    def owner_worker_id(self):
+        return self._owner_worker_id
+
+    def task_id(self):
+        return self._oid.task_id()
+
+    def job_id(self):
+        return self._oid.job_id()
+
+    def __hash__(self):
+        return hash(self._oid)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._oid == self._oid
+
+    def __repr__(self):
+        return f"ObjectRef({self._oid.hex()})"
+
+    # -- lifecycle ------------------------------------------------------------
+    def __del__(self):
+        if self._registered:
+            try:
+                from ray_tpu._private import worker as worker_mod
+
+                cw = worker_mod.global_worker_core()
+                if cw is not None:
+                    cw.deregister_ref(self)
+            except Exception:
+                pass  # interpreter shutdown: imports/loop may be gone
+
+    def __reduce__(self):
+        record_contained_ref(self)
+        return (
+            _reconstruct_ref,
+            (self._oid.binary(), self._owner_addr, self._owner_worker_id),
+        )
+
+    # -- sugar ----------------------------------------------------------------
+    def __await__(self):
+        """Await inside async actors / drivers: yields the resolved value."""
+        from ray_tpu._private import worker as worker_mod
+
+        return worker_mod.get_async(self).__await__()
+
+    def future(self):
+        """A concurrent.futures.Future resolving to the value."""
+        from ray_tpu._private import worker as worker_mod
+
+        return worker_mod.global_worker().core.as_future(self)
+
+
+def _reconstruct_ref(oid_b: bytes, owner_addr, owner_worker_id) -> ObjectRef:
+    return ObjectRef(ObjectID(oid_b), owner_addr, owner_worker_id)

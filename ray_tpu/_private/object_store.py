@@ -73,7 +73,7 @@ def _attach_shm(name: str) -> shared_memory.SharedMemory:
 class _Entry:
     __slots__ = (
         "oid", "shm", "size", "sealed", "pins", "last_access",
-        "is_primary", "spilled_path", "create_t",
+        "is_primary", "spilled_path",
     )
 
     def __init__(self, oid: ObjectID, shm: Optional[shared_memory.SharedMemory], size: int, is_primary: bool):
@@ -85,7 +85,6 @@ class _Entry:
         self.last_access = time.monotonic()
         self.is_primary = is_primary  # created locally by owner (vs pulled copy)
         self.spilled_path: Optional[str] = None
-        self.create_t = time.monotonic()
 
 
 class PlasmaStore:
@@ -123,8 +122,11 @@ class PlasmaStore:
         for e in victims:
             if self.used + size <= self.capacity:
                 break
-            if e.is_primary and self.spill_dir:
-                self._spill(e)
+            if e.is_primary:
+                if self.spill_dir:
+                    self._spill(e)
+                # No spill dir: a primary copy is the ONLY copy — never delete
+                # it to make room; the create fails instead.
             else:
                 self._drop_shm(e)
                 if not e.spilled_path:
@@ -362,11 +364,15 @@ class PlasmaClient:
             pass
 
 
-def register_store_handlers(handlers: dict, store: PlasmaStore, waiters: dict) -> None:
+def register_store_handlers(handlers: dict, store: PlasmaStore, waiters: dict,
+                            on_miss=None) -> None:
     """Wire plasma_* RPC methods into a nodelet server handler table.
 
     ``waiters`` maps ObjectID -> list of asyncio futures resolved when the object
-    becomes local; the nodelet's pull manager also resolves these.
+    becomes local; the nodelet's pull manager also resolves these.  ``on_miss(oid)``
+    is called (on the loop) when a get targets a non-local object — the nodelet's
+    pull manager uses it to start fetching from a remote node (reference:
+    pull_manager.h:52).
     """
     import asyncio
 
@@ -375,35 +381,61 @@ def register_store_handlers(handlers: dict, store: PlasmaStore, waiters: dict) -
         if store.contains(oid):
             return {"exists": True}
         name = store.create(oid, msg["size"])
+        conn.context.setdefault("plasma_creating", set()).add(oid)
         return {"name": name, "exists": False}
 
     async def plasma_seal(conn, msg):
         oid = ObjectID(msg["oid"])
         store.seal(oid)
+        conn.context.get("plasma_creating", set()).discard(oid)
         for fut in waiters.pop(oid, []):
             if not fut.done():
                 fut.set_result(True)
         return True
+
+    def _track_pin(conn, oid):
+        pins = conn.context.setdefault("plasma_pins", {})
+        pins[oid] = pins.get(oid, 0) + 1
 
     async def plasma_get(conn, msg):
         oid = ObjectID(msg["oid"])
         timeout = msg.get("timeout")
         entry = store.get_local(oid)
         if entry is not None:
+            _track_pin(conn, oid)
             return entry
         fut = asyncio.get_event_loop().create_future()
         waiters.setdefault(oid, []).append(fut)
+        if on_miss is not None:
+            on_miss(oid)
         try:
             await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
+            lst = waiters.get(oid)
+            if lst is not None:
+                try:
+                    lst.remove(fut)
+                except ValueError:
+                    pass
+                if not lst:
+                    del waiters[oid]
             return None
-        return store.get_local(oid)
+        entry = store.get_local(oid)
+        if entry is not None:
+            _track_pin(conn, oid)
+        return entry
 
     async def plasma_contains(conn, msg):
         return store.contains(ObjectID(msg["oid"]))
 
     async def plasma_release(conn, msg):
-        store.release(ObjectID(msg["oid"]))
+        oid = ObjectID(msg["oid"])
+        store.release(oid)
+        pins = conn.context.get("plasma_pins", {})
+        if pins.get(oid, 0) > 1:
+            pins[oid] -= 1
+        else:
+            pins.pop(oid, None)
         return True
 
     async def plasma_delete(conn, msg):
@@ -423,3 +455,15 @@ def register_store_handlers(handlers: dict, store: PlasmaStore, waiters: dict) -
         plasma_delete=plasma_delete,
         plasma_stats=plasma_stats,
     )
+
+
+def cleanup_client_connection(store: PlasmaStore, conn) -> None:
+    """Release a dead client's pins and half-written creates (reference: plasma
+    store disconnect cleanup, plasma/store.cc DisconnectClient)."""
+    for oid, n in conn.context.pop("plasma_pins", {}).items():
+        for _ in range(n):
+            store.release(oid)
+    for oid in conn.context.pop("plasma_creating", set()):
+        e = store.objects.get(oid)
+        if e is not None and not e.sealed:
+            store.delete(oid)

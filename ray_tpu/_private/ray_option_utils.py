@@ -1,0 +1,99 @@
+"""Option validation + normalization for tasks and actors.
+
+Counterpart of the reference's option machinery (reference:
+python/ray/_private/ray_option_utils.py).  Produces the resource dict and
+normalized SchedulingStrategy consumed by TaskSpec.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu._private.task_spec import SchedulingStrategy
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+TASK_DEFAULTS = {
+    "num_cpus": 1.0,
+    "num_tpus": 0.0,
+    "num_gpus": 0.0,
+    "resources": None,
+    "num_returns": 1,
+    "max_retries": 3,
+    "retry_exceptions": False,
+    "scheduling_strategy": None,
+    "runtime_env": None,
+    "name": None,
+    "memory": None,
+}
+
+ACTOR_DEFAULTS = {
+    "num_cpus": 1.0,
+    "num_tpus": 0.0,
+    "num_gpus": 0.0,
+    "resources": None,
+    "max_restarts": 0,
+    "max_task_retries": 0,
+    "max_concurrency": 1,
+    "scheduling_strategy": None,
+    "runtime_env": None,
+    "name": None,
+    "namespace": None,
+    "lifetime": None,  # None | "detached"
+    "memory": None,
+}
+
+
+def merge_options(defaults: Dict[str, Any], *layers: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    out = dict(defaults)
+    for layer in layers:
+        if not layer:
+            continue
+        for k, v in layer.items():
+            if k not in defaults:
+                raise ValueError(f"unknown option {k!r}; valid: {sorted(defaults)}")
+            out[k] = v
+    return out
+
+
+def resources_from_options(opts: Dict[str, Any]) -> Dict[str, float]:
+    res: Dict[str, float] = {}
+    if opts.get("num_cpus"):
+        res["CPU"] = float(opts["num_cpus"])
+    if opts.get("num_tpus"):
+        from ray_tpu.accelerators import tpu_manager
+
+        err = tpu_manager().validate_resource_request_quantity(opts["num_tpus"])
+        if err:
+            raise ValueError(err)
+        res["TPU"] = float(opts["num_tpus"])
+    if opts.get("num_gpus"):
+        res["GPU"] = float(opts["num_gpus"])
+    if opts.get("memory"):
+        res["memory"] = float(opts["memory"])
+    for k, v in (opts.get("resources") or {}).items():
+        if k in ("CPU", "TPU", "GPU"):
+            raise ValueError(f"pass {k} via num_{k.lower()}s, not resources=")
+        res[k] = float(v)
+    return res
+
+
+def strategy_from_options(opts: Dict[str, Any]) -> SchedulingStrategy:
+    s = opts.get("scheduling_strategy")
+    if s is None or s == "DEFAULT":
+        return SchedulingStrategy(kind="default")
+    if s == "SPREAD":
+        return SchedulingStrategy(kind="spread")
+    if isinstance(s, PlacementGroupSchedulingStrategy):
+        pg = s.placement_group
+        return SchedulingStrategy(
+            kind="placement_group",
+            placement_group_id=pg.id,
+            placement_group_bundle_index=s.placement_group_bundle_index,
+            placement_group_capture_child_tasks=s.placement_group_capture_child_tasks,
+        )
+    if isinstance(s, NodeAffinitySchedulingStrategy):
+        return SchedulingStrategy(kind="node_affinity", node_id=s.node_id, soft=s.soft)
+    raise ValueError(f"invalid scheduling_strategy: {s!r}")

@@ -1,0 +1,48 @@
+"""Worker process entry point.
+
+Counterpart of the reference's default_worker.py (reference:
+python/ray/_private/workers/default_worker.py): connect to the local nodelet +
+GCS, register, then serve the task-execution loop until killed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nodelet-host", required=True)
+    parser.add_argument("--nodelet-port", type=int, required=True)
+    parser.add_argument("--gcs-host", required=True)
+    parser.add_argument("--gcs-port", type=int, required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--session-dir", default="/tmp/ray_tpu")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO, format="[worker] %(levelname)s %(message)s")
+
+    from ray_tpu._private import worker as worker_mod
+    from ray_tpu._private.core_worker import CoreWorker
+    from ray_tpu._private.ids import NodeID, WorkerID
+
+    core = CoreWorker(
+        mode="worker",
+        gcs_addr=(args.gcs_host, args.gcs_port),
+        nodelet_addr=(args.nodelet_host, args.nodelet_port),
+        worker_id=WorkerID.from_hex(args.worker_id),
+        node_id=NodeID.from_hex(args.node_id),
+        session_dir=args.session_dir,
+    )
+    worker_mod.set_global_core(core)
+    core.register_with_nodelet()
+    # Block forever; the nodelet owns this process's lifetime.
+    core.shutdown_event.wait()
+
+
+if __name__ == "__main__":
+    main()

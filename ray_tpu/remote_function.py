@@ -1,0 +1,62 @@
+"""RemoteFunction: the @remote task handle.
+
+Counterpart of the reference's RemoteFunction (reference:
+python/ray/remote_function.py:266 _remote) with the same .remote()/.options()
+surface.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.ray_option_utils import (
+    TASK_DEFAULTS,
+    merge_options,
+    resources_from_options,
+    strategy_from_options,
+)
+
+
+class RemoteFunction:
+    def __init__(self, fn, options: Optional[Dict[str, Any]] = None):
+        self._function = fn
+        self._default_options = merge_options(TASK_DEFAULTS, options)
+        functools.update_wrapper(self, fn)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"remote function {self._function.__name__!r} cannot be called directly; "
+            f"use {self._function.__name__}.remote()")
+
+    def options(self, **task_options) -> "RemoteFunction":
+        new = RemoteFunction.__new__(RemoteFunction)
+        new._function = self._function
+        new._default_options = merge_options(self._default_options, task_options)
+        functools.update_wrapper(new, self._function)
+        return new
+
+    def remote(self, *args, **kwargs):
+        opts = self._default_options
+        core = worker_mod.require_core()
+        refs = core.submit_task(
+            self._function,
+            args,
+            kwargs,
+            name=opts["name"] or self._function.__qualname__,
+            num_returns=opts["num_returns"],
+            resources=resources_from_options(opts),
+            strategy=strategy_from_options(opts),
+            max_retries=opts["max_retries"],
+            retry_exceptions=opts["retry_exceptions"],
+            runtime_env=opts["runtime_env"],
+        )
+        if opts["num_returns"] == 1:
+            return refs[0]
+        return refs
+
+    @property
+    def func(self):
+        """The underlying Python function (reference exposes __wrapped__)."""
+        return self._function

@@ -1,0 +1,153 @@
+"""Actor tests (reference: python/ray/tests/test_actor.py, test_async_actor.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import RayActorError
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, k=1):
+        self.n += k
+        return self.n
+
+    def read(self):
+        return self.n
+
+
+class TestActors:
+    def test_create_and_call(self, ray_start_regular):
+        c = Counter.remote(5)
+        assert ray_tpu.get(c.incr.remote(), timeout=60) == 6
+        assert ray_tpu.get(c.read.remote(), timeout=30) == 6
+
+    def test_call_ordering(self, ray_start_regular):
+        c = Counter.remote()
+        refs = [c.incr.remote() for _ in range(50)]
+        assert ray_tpu.get(refs, timeout=60) == list(range(1, 51))
+
+    def test_two_actors_isolated(self, ray_start_regular):
+        a, b = Counter.remote(0), Counter.remote(100)
+        ray_tpu.get([a.incr.remote(), b.incr.remote()], timeout=60)
+        assert ray_tpu.get(a.read.remote(), timeout=30) == 1
+        assert ray_tpu.get(b.read.remote(), timeout=30) == 101
+
+    def test_named_actor(self, ray_start_regular):
+        Counter.options(name="ctr").remote(7)
+        h = ray_tpu.get_actor("ctr")
+        assert ray_tpu.get(h.read.remote(), timeout=60) == 7
+
+    def test_named_actor_missing(self, ray_start_regular):
+        with pytest.raises(ValueError):
+            ray_tpu.get_actor("nope")
+
+    def test_actor_method_error(self, ray_start_regular):
+        @ray_tpu.remote
+        class Bad:
+            def boom(self):
+                raise RuntimeError("actor kapow")
+
+        b = Bad.remote()
+        with pytest.raises(RuntimeError):
+            ray_tpu.get(b.boom.remote(), timeout=60)
+
+    def test_kill_actor(self, ray_start_regular):
+        c = Counter.remote()
+        ray_tpu.get(c.read.remote(), timeout=60)
+        ray_tpu.kill(c)
+        with pytest.raises(RayActorError):
+            ray_tpu.get(c.read.remote(), timeout=30)
+
+    def test_handle_passed_to_task(self, ray_start_regular):
+        c = Counter.remote(10)
+        ray_tpu.get(c.read.remote(), timeout=60)
+
+        @ray_tpu.remote
+        def use(h):
+            return ray_tpu.get(h.incr.remote(5))
+
+        assert ray_tpu.get(use.remote(c), timeout=60) == 15
+
+    def test_async_actor_concurrency(self, ray_start_regular):
+        @ray_tpu.remote
+        class AsyncWorker:
+            async def work(self, x):
+                import asyncio
+
+                await asyncio.sleep(0.05)
+                return x
+
+        a = AsyncWorker.remote()
+        ray_tpu.get(a.work.remote(0), timeout=60)  # warm (worker spawn)
+        t0 = time.time()
+        vals = ray_tpu.get([a.work.remote(i) for i in range(10)], timeout=30)
+        assert vals == list(range(10))
+        assert time.time() - t0 < 0.5, "async calls did not overlap"
+
+    def test_actor_restart(self, ray_start_regular):
+        @ray_tpu.remote(max_restarts=1)
+        class Flaky:
+            def __init__(self):
+                self.n = 0
+
+            def pid(self):
+                import os
+
+                return os.getpid()
+
+            def die(self):
+                import os
+
+                os._exit(1)
+
+        f = Flaky.remote()
+        pid1 = ray_tpu.get(f.pid.remote(), timeout=60)
+        f.die.remote()
+        time.sleep(1.0)
+        # After restart the actor runs in a new process.
+        deadline = time.time() + 30
+        pid2 = None
+        while time.time() < deadline:
+            try:
+                pid2 = ray_tpu.get(f.pid.remote(), timeout=10)
+                break
+            except RayActorError:
+                time.sleep(0.5)
+        assert pid2 is not None and pid2 != pid1
+
+    def test_actor_no_restart_dies(self, ray_start_regular):
+        @ray_tpu.remote
+        class Mortal:
+            def die(self):
+                import os
+
+                os._exit(1)
+
+            def ping(self):
+                return "pong"
+
+        m = Mortal.remote()
+        assert ray_tpu.get(m.ping.remote(), timeout=60) == "pong"
+        m.die.remote()
+        with pytest.raises(RayActorError):
+            # retry loop: death may take a moment to propagate
+            for _ in range(20):
+                ray_tpu.get(m.ping.remote(), timeout=10)
+                time.sleep(0.3)
+
+    def test_method_num_returns(self, ray_start_regular):
+        @ray_tpu.remote
+        class Multi:
+            @ray_tpu.method(num_returns=2)
+            def pair(self):
+                return "a", "b"
+
+        m = Multi.remote()
+        r1, r2 = m.pair.remote()
+        assert ray_tpu.get([r1, r2], timeout=60) == ["a", "b"]

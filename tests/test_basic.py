@@ -1,0 +1,151 @@
+"""Core API tests: tasks, objects, errors (reference: python/ray/tests/test_basic.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, RayTaskError
+
+
+@ray_tpu.remote
+def echo(x):
+    return x
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+class TestTasks:
+    def test_simple_task(self, ray_start_regular):
+        assert ray_tpu.get(add.remote(1, 2), timeout=60) == 3
+
+    def test_many_tasks(self, ray_start_regular):
+        refs = [add.remote(i, i) for i in range(64)]
+        assert ray_tpu.get(refs, timeout=60) == [2 * i for i in range(64)]
+
+    def test_kwargs_and_options(self, ray_start_regular):
+        @ray_tpu.remote
+        def f(a, b=2, *, c=3):
+            return a + b + c
+
+        assert ray_tpu.get(f.remote(1), timeout=60) == 6
+        assert ray_tpu.get(f.remote(1, b=5, c=10), timeout=30) == 16
+        assert ray_tpu.get(f.options(name="renamed").remote(1), timeout=30) == 6
+
+    def test_multiple_returns(self, ray_start_regular):
+        @ray_tpu.remote(num_returns=3)
+        def three():
+            return 1, 2, 3
+
+        a, b, c = three.remote()
+        assert ray_tpu.get([a, b, c], timeout=30) == [1, 2, 3]
+
+    def test_nested_tasks(self, ray_start_regular):
+        @ray_tpu.remote
+        def outer(x):
+            return ray_tpu.get(echo.remote(x * 2))
+
+        assert ray_tpu.get(outer.remote(21), timeout=60) == 42
+
+    def test_chained_refs_as_args(self, ray_start_regular):
+        r1 = add.remote(1, 1)
+        r2 = add.remote(r1, 1)
+        r3 = add.remote(r2, r1)
+        assert ray_tpu.get(r3, timeout=60) == 5
+
+    def test_task_error_propagates_type(self, ray_start_regular):
+        @ray_tpu.remote
+        def boom():
+            raise KeyError("missing!")
+
+        with pytest.raises(KeyError):
+            ray_tpu.get(boom.remote(), timeout=60)
+        with pytest.raises(RayTaskError):
+            ray_tpu.get(boom.remote(), timeout=30)
+
+    def test_error_in_dependency_propagates(self, ray_start_regular):
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("upstream")
+
+        r = echo.remote(boom.remote())
+        with pytest.raises(Exception):
+            ray_tpu.get(r, timeout=60)
+
+
+class TestObjects:
+    def test_put_get_small(self, ray_start_regular):
+        ref = ray_tpu.put({"a": 1, "b": [1, 2, 3]})
+        assert ray_tpu.get(ref, timeout=30) == {"a": 1, "b": [1, 2, 3]}
+
+    def test_put_get_large_numpy(self, ray_start_regular):
+        arr = np.random.rand(500_000)
+        ref = ray_tpu.put(arr)
+        out = ray_tpu.get(ref, timeout=30)
+        np.testing.assert_array_equal(out, arr)
+
+    def test_large_arg_and_return(self, ray_start_regular):
+        arr = np.ones(300_000)
+
+        @ray_tpu.remote
+        def double(x):
+            return x * 2
+
+        out = ray_tpu.get(double.remote(arr), timeout=60)
+        np.testing.assert_array_equal(out, arr * 2)
+
+    def test_ref_in_container_arg(self, ray_start_regular):
+        inner = ray_tpu.put(41)
+
+        @ray_tpu.remote
+        def deref(d):
+            return ray_tpu.get(d["ref"]) + 1
+
+        assert ray_tpu.get(deref.remote({"ref": inner}), timeout=60) == 42
+
+    def test_get_timeout(self, ray_start_regular):
+        @ray_tpu.remote
+        def slow():
+            time.sleep(10)
+
+        with pytest.raises(GetTimeoutError):
+            ray_tpu.get(slow.remote(), timeout=0.5)
+
+    def test_wait(self, ray_start_regular):
+        @ray_tpu.remote
+        def sleep_then(x, t):
+            time.sleep(t)
+            return x
+
+        fast = [sleep_then.remote(i, 0.0) for i in range(3)]
+        slow = [sleep_then.remote(99, 5.0)]
+        ready, pending = ray_tpu.wait(fast + slow, num_returns=3, timeout=30)
+        assert len(ready) == 3 and len(pending) == 1
+
+    def test_wait_timeout(self, ray_start_regular):
+        @ray_tpu.remote
+        def slow():
+            time.sleep(10)
+
+        ready, pending = ray_tpu.wait([slow.remote()], num_returns=1, timeout=0.3)
+        assert ready == [] and len(pending) == 1
+
+
+class TestClusterInfo:
+    def test_nodes_and_resources(self, ray_start_regular):
+        ns = ray_tpu.nodes()
+        assert len(ns) == 1 and ns[0]["Alive"]
+        assert ray_tpu.cluster_resources()["CPU"] == 4.0
+
+    def test_runtime_context_in_task(self, ray_start_regular):
+        @ray_tpu.remote
+        def ctx_info():
+            ctx = ray_tpu.get_runtime_context()
+            return ctx.get_task_id(), ctx.get_worker_id()
+
+        task_id, worker_id = ray_tpu.get(ctx_info.remote(), timeout=60)
+        assert task_id and worker_id
