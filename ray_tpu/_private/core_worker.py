@@ -126,6 +126,8 @@ class CoreWorker:
             )
         )
         self.plasma = PlasmaClient(self.io, self.nodelet_conn)
+        self.io.run(self.gcs_conn.call("client_hello",
+                                       {"worker_id": self.worker_id.binary()}))
 
         self._put_task_id = TaskID.for_task(JobID.from_int(0))
         self._put_index = 0
@@ -134,6 +136,7 @@ class CoreWorker:
         self._refs_lock = threading.Lock()
         self._contained: Dict[ObjectID, List[ObjectRef]] = {}
         self._owned_in_plasma: set = set()
+        self._actor_handle_counts: Dict[ActorID, int] = {}
 
         self._owner_conns: Dict[Tuple[str, int], rpc.Connection] = {}
         self._worker_conns: Dict[Tuple[str, int], rpc.Connection] = {}
@@ -350,6 +353,11 @@ class CoreWorker:
         self.ref_counter.remove_local(ref.oid)
         if not self.ref_counter.has(ref.oid):
             self.plasma.release(ref.oid)
+            owner = ref.owner_worker_id()
+            if owner is not None and owner != self.worker_id.binary():
+                # Borrowed value cached by _resolve_one: drop with the last ref
+                # (owned entries are dropped by _on_out_of_scope instead).
+                self.memory_store.delete(ref.oid)
 
     def _on_out_of_scope(self, oid: ObjectID) -> None:
         """Owner-side free: reclaim the value everywhere (reference: distributed
@@ -431,6 +439,27 @@ class CoreWorker:
 
     async def rpc_ping(self, conn, msg):
         return {"worker_id": self.worker_id.binary(), "pid": os.getpid()}
+
+    async def rpc_debug_state(self, conn, msg):
+        """Introspection for the state API + stuck-worker diagnosis."""
+        disp = self._dispatch_task
+        disp_state = None
+        if disp is not None:
+            if disp.done():
+                exc = disp.exception()
+                disp_state = f"DEAD: {exc!r}" if exc else "finished"
+            else:
+                disp_state = "running"
+        return {
+            "mode": self.mode,
+            "pid": os.getpid(),
+            "actor_id": self.actor_id.hex() if self.actor_id else None,
+            "queue_size": self._exec_queue.qsize() if self._exec_queue else None,
+            "dispatch_loop": disp_state,
+            "memory_store_size": self.memory_store.size(),
+            "owned_refs": self.ref_counter.owned_count(),
+            "task": self.task_ctx.task_name if self.task_ctx.task_id else None,
+        }
 
     async def rpc_exit_worker(self, conn, msg):
         logger.info("worker exiting on request")
@@ -556,6 +585,37 @@ class CoreWorker:
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.io.run(self.gcs_conn.call("kill_actor", {
             "actor_id": actor_id.binary(), "no_restart": no_restart}))
+
+    # Distributed actor-handle refcount: this process reports to the GCS when
+    # it starts/stops holding handles for an actor; the GCS reclaims the actor
+    # once no process holds one (reference: actor out-of-scope destruction via
+    # reference counting in core_worker + GcsActorManager).
+    def add_actor_handle(self, actor_id: ActorID) -> None:
+        with self._refs_lock:
+            n = self._actor_handle_counts.get(actor_id, 0)
+            self._actor_handle_counts[actor_id] = n + 1
+        if n == 0 and not self._shut:
+            try:
+                self.io.spawn(self.gcs_conn.notify("actor_holder_update", {
+                    "actor_id": actor_id.binary(),
+                    "holder": self.worker_id.binary(), "add": True}))
+            except Exception:
+                pass
+
+    def remove_actor_handle(self, actor_id: ActorID) -> None:
+        with self._refs_lock:
+            n = self._actor_handle_counts.get(actor_id, 0) - 1
+            if n <= 0:
+                self._actor_handle_counts.pop(actor_id, None)
+            else:
+                self._actor_handle_counts[actor_id] = n
+        if n <= 0 and not self._shut:
+            try:
+                self.io.spawn(self.gcs_conn.notify("actor_holder_update", {
+                    "actor_id": actor_id.binary(),
+                    "holder": self.worker_id.binary(), "add": False}))
+            except Exception:
+                pass
 
     def get_actor_info(self, actor_id: ActorID, wait_alive=False, timeout=None):
         return self.io.run(self.gcs_conn.call("get_actor_info", {
@@ -871,6 +931,7 @@ class NormalTaskSubmitter:
         return conn
 
     async def _request_lease(self, key, st):
+        outcome = "done"  # "done" | "granted" | "retry"
         try:
             if not st["pending"]:
                 return
@@ -892,7 +953,7 @@ class NormalTaskSubmitter:
                              "worker_addr": tuple(resp["worker_addr"]),
                              "worker_id": resp["worker_id"], "nodelet_conn": conn}
                     st["idle"].append(lease)
-                    await self._pump(key, st)
+                    outcome = "granted"
                     return
                 if resp["type"] == "spillback":
                     conn = await self._nodelet_conn(tuple(resp["node_addr"]))
@@ -907,10 +968,19 @@ class NormalTaskSubmitter:
                 return
         except (ConnectionError, asyncio.TimeoutError) as e:
             if not self.cw._shut:
-                logger.warning("lease request failed: %r", e)
-                await asyncio.sleep(0.2)
+                logger.warning("lease request failed (will retry): %r", e)
+                outcome = "retry"
         finally:
             st["inflight"] -= 1
+            if outcome != "done":
+                # "granted": pump to dispatch onto the new lease.
+                # "retry": without a re-pump, this class's pending tasks would
+                # never get another lease request.
+                async def _followup():
+                    if outcome == "retry":
+                        await asyncio.sleep(0.2)
+                    await self._pump(key, st)
+                asyncio.get_event_loop().create_task(_followup())
 
     async def _worker_conn(self, addr) -> rpc.Connection:
         conn = self.cw._worker_conns.get(tuple(addr))

@@ -77,7 +77,8 @@ class NodeInfo:
 class ActorInfo:
     __slots__ = ("actor_id", "spec", "state", "addr", "worker_id", "node_id", "name",
                  "namespace", "num_restarts", "max_restarts", "death_cause", "pending_waiters",
-                 "class_name", "job_id", "start_time", "detached", "creation_conn")
+                 "class_name", "job_id", "start_time", "detached", "creation_conn",
+                 "holders", "had_holder")
 
     def __init__(self, actor_id: ActorID, spec: bytes, name: Optional[str], namespace: str,
                  max_restarts: int, class_name: str, job_id: bytes, detached: bool):
@@ -97,6 +98,10 @@ class ActorInfo:
         self.job_id = job_id
         self.start_time = time.time()
         self.detached = detached
+        # Distributed handle refcount: processes currently holding handles
+        # (reference: actor out-of-scope destruction).
+        self.holders: set = set()
+        self.had_holder = False
 
     def public_info(self) -> dict:
         return {
@@ -159,10 +164,18 @@ class GcsServer:
 
     # ------------------------------------------------------------ liveness
     def _on_disconnect(self, conn: rpc.Connection):
+        loop = asyncio.get_event_loop()
         node_id = conn.context.get("node_id")
         if node_id is not None:
-            loop = asyncio.get_event_loop()
             loop.create_task(self._mark_node_dead(NodeID(node_id), "nodelet connection lost"))
+        holder = conn.context.get("client_worker_id")
+        if holder is not None:
+            loop.create_task(self._drop_holder_everywhere(holder))
+
+    async def rpc_client_hello(self, conn, msg):
+        """CoreWorkers announce themselves so holder state dies with them."""
+        conn.context["client_worker_id"] = msg["worker_id"]
+        return True
 
     async def _health_check_loop(self):
         interval = RayConfig.heartbeat_interval_ms / 1000.0
@@ -199,7 +212,8 @@ class GcsServer:
     # ------------------------------------------------------------- pub/sub
     async def publish(self, channel: str, data: Any):
         dead = []
-        for conn in self.subscribers.get(channel, ()):  # push, no long-poll
+        # Copy: rpc_subscribe can mutate the set while we await a notify.
+        for conn in list(self.subscribers.get(channel, ())):
             try:
                 await conn.notify("publish", {"channel": channel, "data": data})
             except ConnectionError:
@@ -432,15 +446,25 @@ class GcsServer:
                 target = self._pick_node_for(spec.resources)
             if target is not None:
                 try:
+                    # No timeout: this RPC spans the actor's __init__ (can be
+                    # minutes); nodelet/worker death surfaces as ConnectionLost.
                     resp = await target.conn.call(
                         "lease_worker_for_actor",
                         {"spec": info.spec,
                          "bundle": (s.placement_group_id.binary(), s.placement_group_bundle_index)
                          if s.kind == "placement_group" and s.placement_group_id else None},
-                        timeout=RayConfig.gcs_rpc_timeout_s,
+                        timeout=None,
                     )
                 except (ConnectionError, asyncio.TimeoutError):
                     resp = None
+                if resp and not resp.get("ok") and resp.get("error") is not None:
+                    # Constructor raised: deterministic failure, don't retry
+                    # elsewhere (reference: creation task error marks the actor
+                    # dead with the exception as cause).
+                    info.state = "DEAD"
+                    info.death_cause = f"actor constructor raised: {resp.get('reason')}"
+                    await self._publish_actor(info)
+                    return
                 if resp and resp.get("ok"):
                     info.state = "ALIVE"
                     info.addr = tuple(resp["worker_addr"])
@@ -487,7 +511,41 @@ class GcsServer:
                 await self._handle_actor_failure(
                     info, msg.get("reason", "the worker process died")
                 )
+        await self._drop_holder_everywhere(wid)
         return True
+
+    async def rpc_actor_holder_update(self, conn, msg):
+        info = self.actors.get(ActorID(msg["actor_id"]))
+        if info is None:
+            return True
+        if msg["add"]:
+            info.holders.add(msg["holder"])
+            info.had_holder = True
+        else:
+            info.holders.discard(msg["holder"])
+            await self._maybe_reclaim(info)
+        return True
+
+    async def _maybe_reclaim(self, info: ActorInfo):
+        """Destroy an actor whose handles are all out of scope (reference:
+        GcsActorManager::OnActorOutOfScope)."""
+        if (info.had_holder and not info.holders and not info.detached
+                and info.state not in ("DEAD",)):
+            info.max_restarts = info.num_restarts
+            if info.node_id is not None and info.worker_id is not None:
+                node = self.nodes.get(NodeID(info.node_id))
+                if node and node.alive:
+                    try:
+                        await node.conn.call("kill_worker", {"worker_id": info.worker_id})
+                    except ConnectionError:
+                        pass
+            await self._handle_actor_failure(info, "all actor handles went out of scope")
+
+    async def _drop_holder_everywhere(self, holder: bytes):
+        for info in list(self.actors.values()):
+            if holder in info.holders:
+                info.holders.discard(holder)
+                await self._maybe_reclaim(info)
 
     async def rpc_get_actor_info(self, conn, msg):
         actor_id = ActorID(msg["actor_id"])

@@ -29,9 +29,11 @@ def _session_dir() -> str:
     return d
 
 
-def _spawn_and_scrape(cmd, markers, log_path, env=None, timeout=30.0):
+def _spawn_and_scrape(cmd, markers, log_path, env=None, timeout=120.0):
     """Start a subprocess, scrape `MARKER value` lines from stdout, then keep
     draining stdout to a log file on a background thread."""
+    import select
+
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         env=env, cwd=os.getcwd(), text=True, bufsize=1,
@@ -45,19 +47,22 @@ def _spawn_and_scrape(cmd, markers, log_path, env=None, timeout=30.0):
             raise RuntimeError(
                 f"process {cmd[:4]} exited with {proc.returncode} during startup; "
                 f"see {log_path}")
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            proc.kill()
+            raise TimeoutError(f"timed out waiting for {markers} from {cmd[:4]}")
+        # select so a silent-but-alive child cannot block startup forever.
+        ready, _, _ = select.select([proc.stdout], [], [], min(remaining, 0.5))
+        if not ready:
+            continue
         line = proc.stdout.readline()
         if not line:
-            if time.monotonic() > deadline:
-                proc.kill()
-                raise TimeoutError(f"timed out waiting for {markers} from {cmd[:4]}")
             continue
         log_f.write(line)
+        log_f.flush()
         parts = line.strip().split(" ", 1)
         if parts and parts[0] in markers and len(parts) == 2:
             found[parts[0]] = parts[1]
-        if time.monotonic() > deadline:
-            proc.kill()
-            raise TimeoutError(f"timed out waiting for {markers} from {cmd[:4]}")
 
     def drain():
         try:

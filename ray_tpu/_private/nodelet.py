@@ -523,12 +523,20 @@ class Nodelet:
                 view = self.cluster_view.get(target)
                 if view and view.get("addr"):
                     return {"type": "spillback", "node_addr": view["addr"]}
-        # Local grant (or queue until resources free up).
-        if not self._fits_local(resources, bundle):
+        # Local grant (or queue until resources free up).  The pump ACQUIRES on
+        # behalf of the waiter before waking it, so concurrent waiters can never
+        # be granted against the same capacity.
+        if self._fits_local(resources, bundle):
+            self._acquire(resources, bundle)
+        else:
             fut = asyncio.get_event_loop().create_future()
             self._queued_leases.append((resources, bundle, fut))
-            await fut
-        self._acquire(resources, bundle)
+            try:
+                await fut  # resources are acquired by _pump_queued_leases
+            except asyncio.CancelledError:
+                if fut.done() and not fut.cancelled():
+                    self._release(resources, bundle)
+                raise
         try:
             w = await self._pop_worker()
         except asyncio.CancelledError:
@@ -548,6 +556,7 @@ class Nodelet:
             if fut.done():
                 continue
             if self._fits_local(resources, bundle):
+                self._acquire(resources, bundle)  # reserve before waking
                 fut.set_result(True)
             else:
                 self._queued_leases.append((resources, bundle, fut))
@@ -581,7 +590,9 @@ class Nodelet:
             bundle = (bundle[0], bundle[1])
             if bundle not in self.bundles:
                 return {"ok": False, "reason": "unknown bundle"}
-        if not self._fits_local(spec.resources, bundle):
+        if self._fits_local(spec.resources, bundle):
+            self._acquire(spec.resources, bundle)
+        else:
             if not self._feasible_local(spec.resources) and bundle is None:
                 return {"ok": False, "reason": "infeasible"}
             fut = asyncio.get_event_loop().create_future()
@@ -589,16 +600,23 @@ class Nodelet:
             try:
                 await asyncio.wait_for(fut, RayConfig.gcs_rpc_timeout_s * 0.8)
             except asyncio.TimeoutError:
+                # wait_for cancelled fut; the pump skips done futures, so the
+                # reservation was never made for us.
                 return {"ok": False, "reason": "timed out waiting for resources"}
-        self._acquire(spec.resources, bundle)
         w = await self._pop_worker()
         self._lease_seq += 1
         w.lease_id = self._lease_seq
         w.is_actor = True
         self.leases[w.lease_id] = {"resources": spec.resources, "bundle": bundle, "worker": w}
         try:
-            await w.conn.call("push_task", msg["spec"], timeout=RayConfig.worker_register_timeout_s)
-        except (ConnectionError, asyncio.TimeoutError) as e:
+            # No timeout: actor __init__ may legitimately take minutes (model
+            # load, jax backend init); worker death surfaces as ConnectionLost.
+            reply = await w.conn.call("push_task", msg["spec"], timeout=None)
+            if reply.get("status") == "error":
+                await self._handle_worker_death(w, "actor constructor raised", report=False)
+                return {"ok": False, "reason": "actor constructor raised",
+                        "error": reply.get("error")}
+        except ConnectionError as e:
             await self._handle_worker_death(w, f"actor creation failed: {e}")
             return {"ok": False, "reason": f"actor creation failed: {e}"}
         return {"ok": True, "worker_addr": list(w.addr), "worker_id": w.worker_id}

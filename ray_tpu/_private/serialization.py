@@ -168,6 +168,13 @@ def get_serialization_context() -> SerializationContext:
 
 
 def maybe_register_jax(ctx: Optional[SerializationContext] = None) -> None:
+    """Register the jax.Array host-copy serializer.
+
+    MUST NOT create any jax array or query devices: that would initialize a
+    backend (on TPU VMs the runtime client), blocking workers that merely have
+    jax imported.  ``jax.Array`` is an ABC in every concrete array's MRO, which
+    is exactly what the reducer_override lookup walks.
+    """
     import sys
 
     if "jax" not in sys.modules:
@@ -183,6 +190,3 @@ def maybe_register_jax(ctx: Optional[SerializationContext] = None) -> None:
         return np_arr
 
     ctx.register_serializer(jax.Array, _ser_jax, _deser_jax)
-    arr_t = type(jax.numpy.zeros((), dtype="float32"))
-    if arr_t is not jax.Array:
-        ctx.register_serializer(arr_t, _ser_jax, _deser_jax)

@@ -26,6 +26,13 @@ def main(argv=None):
     logging.basicConfig(
         level=logging.INFO, format="[worker] %(levelname)s %(message)s")
 
+    # SIGUSR1 dumps all thread stacks to stderr -> worker log (the `ray stack`
+    # equivalent, reference: python/ray/scripts/scripts.py stack command).
+    import faulthandler
+    import signal
+
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
+
     from ray_tpu._private import worker as worker_mod
     from ray_tpu._private.core_worker import CoreWorker
     from ray_tpu._private.ids import NodeID, WorkerID

@@ -127,12 +127,11 @@ class TPUAcceleratorManager(AcceleratorManager):
         return "TPU_VISIBLE_CHIPS"
 
     def validate_resource_request_quantity(self, quantity: float) -> Optional[str]:
-        q = int(quantity)
-        per_host = 8
-        if q > per_host or (q not in VALID_CHIPS_PER_HOST and q != 0):
+        if quantity != int(quantity) or (int(quantity) not in VALID_CHIPS_PER_HOST
+                                         and quantity != 0):
             return (
                 f"TPU request of {quantity} is invalid: a task can use "
-                f"{VALID_CHIPS_PER_HOST} chips on one host; whole-slice jobs "
-                f"should request TPU-{{pod_type}}-head + per-host gangs instead."
+                f"{VALID_CHIPS_PER_HOST} whole chips on one host; whole-slice "
+                f"jobs should request TPU-{{pod_type}}-head + per-host gangs instead."
             )
         return None

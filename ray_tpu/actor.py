@@ -62,6 +62,22 @@ class ActorHandle:
         self._method_meta = method_meta
         self._max_task_retries = max_task_retries
         self._class_name = class_name
+        # Distributed actor-handle refcount (reference: actor handles tracked
+        # by the ReferenceCounter; actor destroyed when out of scope).
+        self._tracked = False
+        core = worker_mod.global_worker_core()
+        if core is not None:
+            core.add_actor_handle(actor_id)
+            self._tracked = True
+
+    def __del__(self):
+        if getattr(self, "_tracked", False):
+            try:
+                core = worker_mod.global_worker_core()
+                if core is not None:
+                    core.remove_actor_handle(self._actor_id)
+            except Exception:
+                pass  # interpreter shutdown
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):

@@ -1,0 +1,6 @@
+"""Model zoo: TPU-first flax models used by Train/RLlib/Serve and the benches."""
+
+from ray_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+from ray_tpu.models.mlp import MLP
+
+__all__ = ["GPT2Config", "GPT2LMModel", "MLP"]

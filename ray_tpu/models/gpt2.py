@@ -1,0 +1,136 @@
+"""GPT-2 in flax, TPU-first.
+
+The flagship pretraining model (BASELINE.json config #3: GPT-2-small, ICI
+allreduce).  Design choices for the MXU/HBM:
+
+- bfloat16 activations, float32 params + optimizer state (cast at use);
+- fused QKV projection (one big matmul instead of three);
+- attention via ``ray_tpu.ops.flash_attention`` (Pallas blockwise kernel) or
+  ``ring_attention`` when the batch is sequence-sharded over an ``sp`` axis;
+- parameter names line up with ``parallel.sharding.gpt_partition_rules`` so
+  dp/fsdp/tp shardings apply by regex;
+- no data-dependent Python control flow — the whole step is one jit region.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import (
+    flash_attention,
+    mha_reference,
+    ring_attention,
+    ring_attention_sharded,
+)
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "flash"  # "flash" | "ring" | "reference"
+    ring_axis: str = "sp"
+
+    @staticmethod
+    def small() -> "GPT2Config":
+        return GPT2Config()
+
+    @staticmethod
+    def tiny() -> "GPT2Config":
+        return GPT2Config(vocab_size=512, n_positions=128, n_embd=64,
+                          n_layer=2, n_head=4)
+
+
+class Attention(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        cfg = self.config
+        B, S, E = x.shape
+        H = cfg.n_head
+        D = E // H
+        qkv = nn.Dense(3 * E, dtype=cfg.dtype, name="qkv_proj")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        if cfg.attention_impl == "ring":
+            # Under jit/GSPMD the sp axis is made manual via shard_map; inside
+            # an explicit shard_map (axis already bound) call ring_attention
+            # directly instead.
+            out = ring_attention_sharded(q, k, v, causal=True,
+                                         seq_axis=cfg.ring_axis)
+        elif cfg.attention_impl == "reference":
+            out = mha_reference(q, k, v, causal=True)
+        else:
+            out = flash_attention(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(B, S, E)
+        return nn.Dense(E, dtype=cfg.dtype, name="out_proj")(out)
+
+
+class MlpBlock(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.Dense(4 * cfg.n_embd, dtype=cfg.dtype, name="fc_in")(x)
+        h = jax.nn.gelu(h)
+        return nn.Dense(cfg.n_embd, dtype=cfg.dtype, name="fc_out")(h)
+
+
+class Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool = True):
+        cfg = self.config
+        x = x + Attention(cfg, name="attn")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x),
+            deterministic=deterministic)
+        x = x + MlpBlock(cfg, name="mlp")(
+            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x))
+        return x
+
+
+class GPT2LMModel(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic: bool = True):
+        cfg = self.config
+        B, S = input_ids.shape
+        pos = jnp.arange(S)[None, :]
+        tok = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype, name="wte")(input_ids)
+        pe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype, name="wpe")(pos)
+        x = tok + pe
+        for i in range(cfg.n_layer):
+            # remat each block: trade FLOPs for HBM (activations recomputed in
+            # backward) — the standard TPU memory/bandwidth trade.
+            x = nn.remat(Block)(cfg, name=f"h_{i}")(x, deterministic=deterministic)
+        x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                          name="lm_head")(x)
+        return logits
+
+
+def lm_loss(logits, targets, mask=None):
+    """Mean next-token cross entropy in f32."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        return -jnp.mean(ll)
+    mask = mask.astype(jnp.float32)
+    return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -1,0 +1,25 @@
+"""MNIST-scale MLP (BASELINE.json config #2: JaxTrainer MNIST MLP, DP over 8 chips)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (512, 256, 10)
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        for i, f in enumerate(self.features[:-1]):
+            x = nn.relu(nn.Dense(f, name=f"dense_{i}")(x))
+        return nn.Dense(self.features[-1], name="head")(x)
+
+
+def classification_loss(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))

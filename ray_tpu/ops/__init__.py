@@ -1,0 +1,15 @@
+"""TPU kernels (Pallas) + XLA reference implementations.
+
+The hot ops of the ML stack: blockwise (flash) attention, ring attention for
+sequence parallelism (absent from the reference — SURVEY §5.7 greenfield), GAE
+scans for RL.  Every op has an XLA fallback used automatically off-TPU and for
+verification.
+"""
+
+from ray_tpu.ops.attention import flash_attention, mha_reference, ring_attention
+from ray_tpu.ops.gae import discounted_returns, gae_advantages
+
+__all__ = [
+    "flash_attention", "mha_reference", "ring_attention",
+    "gae_advantages", "discounted_returns",
+]

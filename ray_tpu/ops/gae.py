@@ -1,0 +1,57 @@
+"""Generalized Advantage Estimation as parallel scans.
+
+Counterpart of the reference's GAE (reference: rllib/evaluation/postprocessing.py:88
+compute_advantages — a Python backward loop over numpy; new stack
+rllib/utils/postprocessing/value_predictions.py:7).  TPU-native: the backward
+recurrence A_t = δ_t + γλ(1-done_t) A_{t+1} is a first-order linear recurrence,
+so it maps onto ``jax.lax.associative_scan`` — O(log T) depth on the VPU instead
+of a serial T-step loop.  This is the BASELINE.json 'Pallas GAE' target; the
+associative-scan form is what XLA compiles to a near-roofline scan kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _linrec_combine(a, b):
+    """Combine for y_t = c_t * y_{t+1} + d_t (scanned right-to-left)."""
+    c_a, d_a = a
+    c_b, d_b = b
+    return c_a * c_b, d_b + c_b * d_a
+
+
+def discounted_returns(rewards, dones, gamma: float, bootstrap_value=None):
+    """R_t = r_t + γ(1-done_t) R_{t+1}; rewards/dones: (T,) or (T, B)."""
+    cont = gamma * (1.0 - dones.astype(rewards.dtype))
+    last = jnp.zeros_like(rewards[-1]) if bootstrap_value is None else bootstrap_value
+    d = rewards.at[-1].add(cont[-1] * last) if bootstrap_value is not None else rewards
+    c_rev = jnp.flip(cont, 0)
+    d_rev = jnp.flip(d, 0)
+    _, y_rev = jax.lax.associative_scan(_linrec_combine, (c_rev, d_rev), axis=0)
+    return jnp.flip(y_rev, 0)
+
+
+def gae_advantages(rewards, values, dones, gamma: float = 0.99,
+                   gae_lambda: float = 0.95,
+                   bootstrap_value=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """GAE advantages + value targets.
+
+    rewards/dones: (T,) or (T, B); values: same shape (V(s_t));
+    bootstrap_value: V(s_T) for the state after the last step (0 if None).
+    Returns (advantages, value_targets) with targets = advantages + values.
+    """
+    if bootstrap_value is None:
+        bootstrap_value = jnp.zeros_like(values[-1])
+    next_values = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    not_done = 1.0 - dones.astype(values.dtype)
+    deltas = rewards + gamma * not_done * next_values - values
+    c = gamma * gae_lambda * not_done
+    c_rev = jnp.flip(c, 0)
+    d_rev = jnp.flip(deltas, 0)
+    _, a_rev = jax.lax.associative_scan(_linrec_combine, (c_rev, d_rev), axis=0)
+    adv = jnp.flip(a_rev, 0)
+    return adv, adv + values

@@ -1,0 +1,83 @@
+"""Device mesh construction for dp/fsdp/tp/sp/ep axes.
+
+TPU-native core: a ``jax.sharding.Mesh`` over all global devices, with ICI-
+friendly axis ordering (innermost axes map to physically-adjacent chips so tp/sp
+collectives ride the fastest links — `jax.experimental.mesh_utils` handles the
+physical layout).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXES = ("dp", "fsdp", "tp", "sp", "ep")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Sizes per logical axis; -1 on at most one axis means 'absorb the rest'."""
+
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp,
+                 "sp": self.sp, "ep": self.ep}
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one axis may be -1, got {wild}")
+        fixed = int(np.prod([v for v in sizes.values() if v != -1]))
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        total = int(np.prod(list(sizes.values())))
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {sizes} covers {total} devices but {n_devices} are present")
+        return sizes
+
+
+def mesh_shape_for(n_devices: int, tp: int = 1, sp: int = 1, fsdp: int = 1,
+                   ep: int = 1) -> Dict[str, int]:
+    return MeshConfig(dp=-1, fsdp=fsdp, tp=tp, sp=sp, ep=ep).resolve(n_devices)
+
+
+def build_mesh(config: Optional[MeshConfig] = None, devices=None):
+    """Build a Mesh over the given (default: all global) devices.
+
+    Axis order is (dp, fsdp, sp, tp, ep) outer→inner: tp/ep innermost so their
+    all-to-all/all-gather traffic lands on the closest ICI neighbors.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    config = config or MeshConfig()
+    sizes = config.resolve(len(devices))
+    order = ("dp", "fsdp", "sp", "tp", "ep")
+    shape = tuple(sizes[a] for a in order)
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, order)
+
+
+def local_mesh(axis: str = "dp"):
+    """A 1-axis mesh over this process's addressable devices (single-host DP)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.asarray(jax.local_devices())
+    return Mesh(devs, (axis,))

@@ -1,0 +1,101 @@
+"""Partition rules: map parameter names to PartitionSpecs over the mesh.
+
+Regex-rule matching in the t5x/EasyLM style (public pattern; see SNIPPETS.md [3]
+for the shape of the idea): each rule is (name_regex, PartitionSpec); the first
+match wins; scalars are replicated.  This is the TP/FSDP machinery the reference
+delegates to DeepSpeed/Accelerate (SURVEY §2.3 'TP: absent from Ray itself') —
+here it is first-class and compiler-driven (GSPMD inserts the collectives).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _spec(*axes):
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec(*axes)
+
+
+class PartitionRules:
+    def __init__(self, rules: Sequence[Tuple[str, Any]]):
+        self.rules = list(rules)
+
+    def spec_for(self, path: str, shape: Tuple[int, ...]):
+        from jax.sharding import PartitionSpec
+
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return PartitionSpec()
+        for pattern, spec in self.rules:
+            if re.search(pattern, path):
+                return spec
+        return PartitionSpec()  # replicate by default
+
+
+def gpt_partition_rules() -> PartitionRules:
+    """Megatron-style TP + FSDP sharding for the GPT family (ray_tpu.models.gpt2).
+
+    Weight matrices split on 'tp'; the remaining big dimension is sharded over
+    'fsdp' so parameters also scale with the fsdp axis (ZeRO-3-like).  XLA turns
+    these into all-gather on use + reduce-scatter on grad, over ICI.
+    """
+    return PartitionRules([
+        # embeddings: (vocab, embed) — vocab on tp, embed on fsdp
+        (r"wte/embedding", _spec("tp", "fsdp")),
+        (r"wpe/embedding", _spec(None, "fsdp")),
+        # attention qkv: (embed, heads*head_dim) — split heads over tp
+        (r"attn/(q|k|v|qkv)_proj/kernel", _spec("fsdp", "tp")),
+        (r"attn/out_proj/kernel", _spec("tp", "fsdp")),
+        # mlp: (embed, 4*embed) in, (4*embed, embed) out
+        (r"mlp/fc_in/kernel", _spec("fsdp", "tp")),
+        (r"mlp/fc_out/kernel", _spec("tp", "fsdp")),
+        # biases/layernorms replicated
+        (r"bias|scale|ln", _spec()),
+        # lm head (embed, vocab)
+        (r"lm_head/kernel", _spec("fsdp", "tp")),
+    ])
+
+
+def _flatten_with_paths(tree):
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k))))
+            for k in path)
+        out.append((name, leaf))
+    return out, treedef
+
+
+def match_partition_rules(rules: PartitionRules, params):
+    """Pytree of params → pytree of PartitionSpec."""
+    import jax
+
+    flat, treedef = _flatten_with_paths(params)
+    specs = [rules.spec_for(name, getattr(leaf, "shape", ())) for name, leaf in flat]
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def shard_pytree(params, specs, mesh):
+    """Device-put a pytree with NamedShardings built from specs."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+
+
+def with_sharding_constraint(x, spec, mesh=None):
+    """Annotate an intermediate value's sharding (inside jit)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    if mesh is not None:
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+    return jax.lax.with_sharding_constraint(x, spec)

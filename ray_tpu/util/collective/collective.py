@@ -1,0 +1,238 @@
+"""Eager cross-process collectives (the gloo-equivalent backend).
+
+API mirrors the reference (reference: python/ray/util/collective/collective.py —
+init_collective_group :120, allreduce :258, declare_collective_group, etc.).
+Rendezvous rides the GCS KV (the reference uses a named store actor, reference:
+util/collective/util.py NCCLUniqueIDStore); data moves directly between member
+processes over the runtime RPC with pickle-5 zero-copy buffers.
+
+Topology: root-reduce for v1 (rank 0 reduces + broadcasts — fine for the small
+worlds this backend serves: host-side sync, CPU tests).  The bandwidth-optimal
+path for tensors is the ``xla`` backend over ICI; upgrading this one to a ring
+reduce-scatter is tracked for when eager host collectives get hot.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.config import RayConfig
+from ray_tpu.exceptions import CollectiveError
+
+_groups: Dict[str, "Group"] = {}
+_lock = threading.Lock()
+
+
+class Group:
+    def __init__(self, name: str, world_size: int, rank: int):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.core = worker_mod.require_core()
+        self.seq = 0
+        self._inbox: Dict[tuple, Any] = {}
+        self._inbox_cv = threading.Condition()
+        self._member_addrs: Dict[int, tuple] = {}
+        handler_name = f"col_{name}"
+        self.core.server.handlers[handler_name] = self._on_message
+        self._handler_name = handler_name
+        self._register()
+
+    # ------------------------------------------------------------ rendezvous
+    def _kv(self, op, **kw):
+        return self.core.io.run(self.core.gcs_conn.call(op, kw))
+
+    def _register(self):
+        import pickle
+
+        key = f"collective/{self.name}/{self.rank}"
+        addr = pickle.dumps(tuple(self.core.addr))
+        self._kv("kv_put", ns="collective", key=key, value=addr, overwrite=True)
+        deadline = time.monotonic() + RayConfig.collective_rendezvous_timeout_s
+        while True:
+            keys = self._kv("kv_keys", ns="collective", prefix=f"collective/{self.name}/")
+            if len(keys) >= self.world_size:
+                break
+            if time.monotonic() > deadline:
+                raise CollectiveError(
+                    f"collective group {self.name!r}: only {len(keys)}/"
+                    f"{self.world_size} members after rendezvous timeout")
+            time.sleep(0.05)
+        vals = self._kv("kv_multi_get", ns="collective",
+                        keys=[f"collective/{self.name}/{r}" for r in range(self.world_size)])
+        for r in range(self.world_size):
+            self._member_addrs[r] = tuple(pickle.loads(vals[f"collective/{self.name}/{r}"]))
+
+    def _conn(self, rank: int):
+        return self.core._owner_conn(self._member_addrs[rank])
+
+    # ------------------------------------------------------------- messaging
+    async def _on_message(self, conn, msg):
+        key = (msg["seq"], msg["src"], msg.get("tag", 0))
+        with self._inbox_cv:
+            self._inbox[key] = msg["data"]
+            self._inbox_cv.notify_all()
+        return True
+
+    def _send_to(self, rank: int, data, seq: int, tag: int = 0):
+        self._conn(rank).call_sync(
+            self._handler_name,
+            {"seq": seq, "src": self.rank, "tag": tag, "data": data},
+            timeout=RayConfig.collective_op_timeout_s)
+
+    def _recv_from(self, rank: int, seq: int, tag: int = 0):
+        key = (seq, rank, tag)
+        deadline = time.monotonic() + RayConfig.collective_op_timeout_s
+        with self._inbox_cv:
+            while key not in self._inbox:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise CollectiveError(
+                        f"timeout waiting for rank {rank} in group {self.name!r}")
+                self._inbox_cv.wait(min(remaining, 1.0))
+            return self._inbox.pop(key)
+
+    # ------------------------------------------------------------ primitives
+    def allreduce(self, array, op: str = "sum"):
+        seq = self._next_seq()
+        arr = np.asarray(array)
+        if self.rank == 0:
+            acc = arr.astype(np.float64 if op in ("sum", "mean") else arr.dtype)
+            for r in range(1, self.world_size):
+                other = np.asarray(self._recv_from(r, seq))
+                if op in ("sum", "mean"):
+                    acc = acc + other
+                elif op == "max":
+                    acc = np.maximum(acc, other)
+                elif op == "min":
+                    acc = np.minimum(acc, other)
+                else:
+                    raise ValueError(f"unsupported op {op!r}")
+            if op == "mean":
+                acc = acc / self.world_size
+            result = acc.astype(arr.dtype)
+            for r in range(1, self.world_size):
+                self._send_to(r, result, seq, tag=1)
+            return result
+        self._send_to(0, arr, seq)
+        return np.asarray(self._recv_from(0, seq, tag=1))
+
+    def allgather(self, array) -> List[np.ndarray]:
+        seq = self._next_seq()
+        arr = np.asarray(array)
+        if self.rank == 0:
+            parts = [arr] + [np.asarray(self._recv_from(r, seq))
+                             for r in range(1, self.world_size)]
+            for r in range(1, self.world_size):
+                self._send_to(r, parts, seq, tag=1)
+            return parts
+        self._send_to(0, arr, seq)
+        return [np.asarray(a) for a in self._recv_from(0, seq, tag=1)]
+
+    def reducescatter(self, array, op: str = "sum"):
+        full = self.allreduce(array, op)
+        return np.array_split(full, self.world_size)[self.rank]
+
+    def broadcast(self, array, root: int = 0):
+        seq = self._next_seq()
+        if self.rank == root:
+            arr = np.asarray(array)
+            for r in range(self.world_size):
+                if r != root:
+                    self._send_to(r, arr, seq)
+            return arr
+        return np.asarray(self._recv_from(root, seq))
+
+    def barrier(self):
+        self.allreduce(np.zeros((), np.float32))
+
+    def send(self, array, dst_rank: int, tag: int = 0):
+        # Tagged p2p rides its own seq namespace (negative tags avoid
+        # colliding with collective seqs).
+        self._send_to(dst_rank, np.asarray(array), -1, tag=tag + 2)
+
+    def recv(self, src_rank: int, tag: int = 0):
+        return np.asarray(self._recv_from(src_rank, -1, tag=tag + 2))
+
+    def _next_seq(self) -> int:
+        self.seq += 1
+        return self.seq
+
+    def destroy(self):
+        self.core.server.handlers.pop(self._handler_name, None)
+        if self.rank == 0:
+            try:
+                self._kv("kv_del", ns="collective", key=f"collective/{self.name}/",
+                         prefix=True)
+            except Exception:
+                pass
+
+
+# ================================================================ public API
+def init_collective_group(world_size: int, rank: int, backend: str = "cpu",
+                          group_name: str = "default") -> None:
+    """Join a collective group from this process (reference: collective.py:120)."""
+    if backend not in ("cpu", "gloo", "xla"):
+        raise ValueError(f"unsupported backend {backend!r}; use 'cpu' or 'xla'")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    with _lock:
+        if group_name in _groups:
+            raise RuntimeError(f"collective group {group_name!r} already initialized")
+        _groups[group_name] = Group(group_name, world_size, rank)
+
+
+def _group(group_name: str) -> Group:
+    g = _groups.get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} is not initialized in this process")
+    return g
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _lock:
+        g = _groups.pop(group_name, None)
+    if g is not None:
+        g.destroy()
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _group(group_name).world_size
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    return _group(group_name).allreduce(tensor, op)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _group(group_name).allgather(tensor)
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+    return _group(group_name).reducescatter(tensor, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _group(group_name).broadcast(tensor, root=src_rank)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0):
+    _group(group_name).send(tensor, dst_rank, tag)
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0):
+    return _group(group_name).recv(src_rank, tag)
+
+
+def barrier(group_name: str = "default"):
+    _group(group_name).barrier()

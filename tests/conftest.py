@@ -9,7 +9,10 @@ python/ray/tests/accelerators/test_tpu.py).
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# FORCE cpu (the TPU-VM base env pins JAX_PLATFORMS=axon; setdefault would lose):
+# tests must never touch the real chip — the virtual 8-device CPU mesh is the
+# test substrate, and a wedged/contended TPU tunnel must not hang the suite.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
@@ -20,14 +23,40 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
 
+SHARED_CPUS = 8.0
+
+
+def ensure_shared_runtime():
+    """Idempotently (re)start the shared single-node runtime.
+
+    Per-test clusters are too slow on a 1-CPU box (gcs+nodelet+workers at ~2s
+    python startup each), so tests share one runtime like the reference's
+    shared ray_start fixtures (python/ray/tests/conftest.py); tests that tear
+    clusters down (ray_start_isolated / ray_start_cluster) leave the runtime
+    stopped and the next shared test restarts it here.
+    """
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=SHARED_CPUS, object_store_memory=256 * 1024**2)
+    return ray_tpu
+
 
 @pytest.fixture
 def ray_start_regular():
-    """Start a fresh single-node runtime for a test, like the reference fixture
-    python/ray/tests/conftest.py:419."""
+    """A view on the shared runtime (reference: conftest.py:419 shared mode).
+    Tests may create actors/tasks freely; they must not assume exclusive
+    cluster resources."""
+    yield ensure_shared_runtime()
+
+
+@pytest.fixture
+def ray_start_isolated():
+    """A fresh runtime for tests that mutate cluster state (node death etc.)."""
     import ray_tpu
 
-    ray_tpu.init(num_cpus=4, object_store_memory=256 * 1024**2)
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, object_store_memory=128 * 1024**2)
     yield ray_tpu
     ray_tpu.shutdown()
 
@@ -35,9 +64,21 @@ def ray_start_regular():
 @pytest.fixture
 def ray_start_cluster():
     """Multi-node in-process cluster factory (reference: conftest.py:500 +
-    cluster_utils.Cluster)."""
+    cluster_utils.Cluster).  The test is responsible for init(address=...)."""
+    import ray_tpu
     from ray_tpu.cluster_utils import Cluster
 
+    ray_tpu.shutdown()
     cluster = Cluster()
     yield cluster
+    ray_tpu.shutdown()
     cluster.shutdown()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    try:
+        import ray_tpu
+
+        ray_tpu.shutdown()
+    except Exception:
+        pass

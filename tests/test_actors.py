@@ -39,7 +39,7 @@ class TestActors:
         assert ray_tpu.get(b.read.remote(), timeout=30) == 101
 
     def test_named_actor(self, ray_start_regular):
-        Counter.options(name="ctr").remote(7)
+        keep = Counter.options(name="ctr").remote(7)  # noqa: F841 — handle keeps actor alive
         h = ray_tpu.get_actor("ctr")
         assert ray_tpu.get(h.read.remote(), timeout=60) == 7
 

@@ -139,7 +139,7 @@ class TestClusterInfo:
     def test_nodes_and_resources(self, ray_start_regular):
         ns = ray_tpu.nodes()
         assert len(ns) == 1 and ns[0]["Alive"]
-        assert ray_tpu.cluster_resources()["CPU"] == 4.0
+        assert ray_tpu.cluster_resources()["CPU"] >= 4.0
 
     def test_runtime_context_in_task(self, ray_start_regular):
         @ray_tpu.remote
