@@ -1,0 +1,30 @@
+"""Platform forcing for tests/dryruns.
+
+The TPU-VM base image pins JAX at the axon/TPU backend two ways: the
+JAX_PLATFORMS env var AND a site hook that re-pins jax.config.jax_platforms
+after import.  Anything that must run on the virtual CPU mesh (tests, the
+multi-chip dryrun) has to defeat both BEFORE the first backend/device use,
+otherwise a wedged TPU tunnel hangs the process.  Single authoritative
+implementation — do not copy this dance elsewhere.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_platform(n_devices: int = 8) -> None:
+    """Force JAX onto ``n_devices`` virtual CPU devices.
+
+    Must be called before any jax device/backend use.  Safe to call more than
+    once with the same ``n_devices``; the flag append is idempotent.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

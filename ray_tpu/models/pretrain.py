@@ -9,6 +9,7 @@ ppermute for sp attention).
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, Dict, Optional, Tuple
 
@@ -36,9 +37,14 @@ def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
 
 def init_params(config: GPT2Config, rng=None):
     model = GPT2LMModel(config)
+    # Param shapes are independent of the attention impl; init with the
+    # reference impl so initialization never needs an active mesh (ring
+    # attention requires one) nor block-aligned dummy shapes (flash).
+    init_model = GPT2LMModel(
+        dataclasses.replace(config, attention_impl="reference"))
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     dummy = jnp.zeros((1, min(8, config.n_positions)), jnp.int32)
-    return model, model.init(rng, dummy)["params"]
+    return model, init_model.init(rng, dummy)["params"]
 
 
 def loss_fn(model: GPT2LMModel, params, batch):
@@ -68,7 +74,7 @@ class ShardedPretrainer:
         self.mesh = build_mesh(mesh_config or MeshConfig(), devices=devices)
         if self.mesh.shape.get("sp", 1) > 1 and config.attention_impl == "flash":
             # sequence sharding needs the ring kernel
-            config = GPT2Config(**{**config.__dict__, "attention_impl": "ring"})
+            config = dataclasses.replace(config, attention_impl="ring")
             self.config = config
         self.model, params = init_params(config)
         self.tx = make_optimizer(lr, total_steps=total_steps)

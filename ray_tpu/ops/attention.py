@@ -58,8 +58,12 @@ def mha_reference(q, k, v, *, causal: bool = True, sm_scale: Optional[float] = N
 
 # ======================================================== pallas forward
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, qo_ref, ko_ref, o_ref, lse_ref,
-                      *, block_k: int, sm_scale: float, causal: bool):
-    # q_ref: (block_q, d); k_ref/v_ref: (S_k, d) for this (b,h).
+                      *, block_k: int, sm_scale: float, causal: bool,
+                      s_k_real: int):
+    # q_ref: (block_q, d); k_ref/v_ref: (S_k padded, d) for this (b,h).
+    # s_k_real: the unpadded key length — columns >= s_k_real are padding and
+    # always masked out (the S_k buffer is padded to a block_k multiple so
+    # pl.ds never clamps/re-reads earlier keys).
     block_q, d = q_ref.shape
     s_k = k_ref.shape[0]
     iq = pl.program_id(1)
@@ -75,13 +79,19 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, qo_ref, ko_ref, o_ref, lse_ref,
         vblk = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        k_idx = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) \
+            + j * block_k
+        valid = k_idx < s_k_real
         if causal:
-            k_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) \
-                + j * block_k + ko_ref[0]
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            k_pos = k_idx + ko_ref[0]
+            valid = valid & (q_pos >= k_pos)
+        s = jnp.where(valid, s, NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new[:, None])
+        # Fully-masked row so far (m_new == NEG_INF): exp(s - m) would be
+        # exp(0) = 1 per column; force p = 0 so such rows stay empty.
+        p = jnp.where(m_new[:, None] <= NEG_INF / 2, 0.0,
+                      jnp.exp(s - m_new[:, None]))
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
@@ -112,29 +122,44 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, qo_ref, ko_ref, o_ref, lse_ref,
     lse_ref[:] = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
 
 
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
 def _flash_forward(q, k, v, causal: bool, sm_scale: float, q_offset, k_offset,
                    block_q: int, block_k: int, interpret: bool):
     b, h, s_q, d = q.shape
     s_k = k.shape[2]
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_k)
+    # Pad both sequence dims to block multiples: pl.ds with a clamped start
+    # would silently re-read earlier rows under mislabeled positions (the
+    # round-1 advisor bug).  Padded q rows are dropped on return; padded kv
+    # columns are masked inside the kernel via s_k_real.
+    s_q_pad = _round_up(s_q, block_q)
+    s_k_pad = _round_up(s_k, block_k)
     qr = q.reshape(b * h, s_q, d)
     kr = k.reshape(b * h, s_k, d)
     vr = v.reshape(b * h, s_k, d)
+    if s_q_pad != s_q:
+        qr = jnp.pad(qr, ((0, 0), (0, s_q_pad - s_q), (0, 0)))
+    if s_k_pad != s_k:
+        kr = jnp.pad(kr, ((0, 0), (0, s_k_pad - s_k), (0, 0)))
+        vr = jnp.pad(vr, ((0, 0), (0, s_k_pad - s_k), (0, 0)))
     qo = jnp.asarray([q_offset], jnp.int32)
     ko = jnp.asarray([k_offset], jnp.int32)
 
     from jax.experimental.pallas import tpu as pltpu
 
-    grid = (b * h, pl.cdiv(s_q, block_q))
+    grid = (b * h, s_q_pad // block_q)
     out, lse = pl.pallas_call(
         functools.partial(_flash_fwd_kernel, block_k=block_k,
-                          sm_scale=sm_scale, causal=causal),
+                          sm_scale=sm_scale, causal=causal, s_k_real=s_k),
         grid=grid,
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda bh, iq: (bh, iq, 0)),
-            pl.BlockSpec((None, s_k, d), lambda bh, iq: (bh, 0, 0)),
-            pl.BlockSpec((None, s_k, d), lambda bh, iq: (bh, 0, 0)),
+            pl.BlockSpec((None, s_k_pad, d), lambda bh, iq: (bh, 0, 0)),
+            pl.BlockSpec((None, s_k_pad, d), lambda bh, iq: (bh, 0, 0)),
             pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
@@ -143,11 +168,13 @@ def _flash_forward(q, k, v, causal: bool, sm_scale: float, q_offset, k_offset,
             pl.BlockSpec((None, block_q), lambda bh, iq: (bh, iq)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s_q), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s_q_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s_q_pad), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr, qo, ko)
+    out = out[:, :s_q]
+    lse = lse[:, :s_q]
     return out.reshape(b, h, s_q, d), lse.reshape(b, h, s_q)
 
 
@@ -163,21 +190,34 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, q_offset, k_offset,
     of = out.astype(jnp.float32)
     delta = jnp.sum(of * gf, axis=-1)  # (b,h,s_q)
 
-    num_kv = max(s_k // block_k, 1)
+    # Mirror the forward's clamping, and pad s_k to a block multiple so the
+    # reshape below is always valid (the round-1 advisor crash: any s_k not a
+    # multiple of the user block_k, e.g. every sequence shorter than 128).
+    block_k = min(block_k, s_k)
+    s_k_pad = _round_up(s_k, block_k)
+    if s_k_pad != s_k:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, s_k_pad - s_k), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, s_k_pad - s_k), (0, 0)))
+    num_kv = s_k_pad // block_k
     kb = k.reshape(b, h, num_kv, block_k, d).astype(jnp.float32)
     vb = v.reshape(b, h, num_kv, block_k, d).astype(jnp.float32)
 
     q_pos = jnp.arange(s_q) + q_offset
+    # Rows with an empty (fully-masked) softmax have lse == NEG_INF; their
+    # exp(s - lse) would blow up — zero them instead.
+    live_row = (lse > NEG_INF / 2)[..., None]
 
     def one_block(j):
         kj = kb[:, :, j]  # (b,h,block_k,d)
         vj = vb[:, :, j]
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kj)
+        k_idx = jnp.arange(block_k) + j * block_k
+        valid = (k_idx < s_k)[None, :]
         if causal:
-            k_pos = jnp.arange(block_k) + j * block_k + k_offset
-            mask = q_pos[:, None] >= k_pos[None, :]
-            s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse[..., None])  # (b,h,q,block_k)
+            k_pos = k_idx + k_offset
+            valid = valid & (q_pos[:, None] >= k_pos[None, :])
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.where(live_row, jnp.exp(s - lse[..., None]), 0.0)  # (b,h,q,block_k)
         dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
         dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vj)
         ds = p * (dp - delta[..., None])
@@ -192,10 +232,12 @@ def _flash_backward(q, k, v, out, lse, g, causal, sm_scale, q_offset, k_offset,
 
     dq0 = jnp.zeros((b, h, s_q, d), jnp.float32)
     dq, (dk_blocks, dv_blocks) = jax.lax.scan(scan_body, dq0, jnp.arange(num_kv))
-    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, s_k, d)
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, s_k_pad, d)[:, :, :s_k]
+    # s = (q*sm_scale)·kᵀ, so dL/dq needs the extra sm_scale while dL/dk
+    # already carries it through qf.
     dq = dq * sm_scale
-    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, s_k, d)
-    return dq.astype(q.dtype), (dk * sm_scale).astype(k.dtype), dv.astype(v.dtype)
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, s_k_pad, d)[:, :, :s_k]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 # ============================================================= public op

@@ -9,17 +9,14 @@ python/ray/tests/accelerators/test_tpu.py).
 import os
 import sys
 
-# FORCE cpu (the TPU-VM base env pins JAX_PLATFORMS=axon; setdefault would lose):
-# tests must never touch the real chip — the virtual 8-device CPU mesh is the
-# test substrate, and a wedged/contended TPU tunnel must not hang the suite.
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# FORCE cpu: tests must never touch the real chip — the virtual 8-device CPU
+# mesh is the test substrate, and a wedged/contended TPU tunnel must not hang
+# the suite.  (Env var alone is insufficient; see _private/platform.py.)
+from ray_tpu._private.platform import force_cpu_platform  # noqa: E402
+
+force_cpu_platform(8)
 
 import pytest  # noqa: E402
 

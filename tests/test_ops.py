@@ -52,6 +52,49 @@ class TestFlashAttention:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(a, b, atol=5e-3, rtol=5e-3)
 
+    def test_unaligned_seq_forward(self):
+        # round-1 advisor bug: s_k not a multiple of block_k silently
+        # double-counted re-read keys (s=200 with default 128 blocks).
+        q, k, v = _qkv(s=200)
+        out = flash_attention(q, k, v, causal=True)
+        ref = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+    def test_unaligned_seq_noncausal(self):
+        q, k, v = _qkv(s=200)
+        out = flash_attention(q, k, v, causal=False)
+        ref = mha_reference(q, k, v, causal=False)
+        np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+    def test_short_seq_gradients(self):
+        # round-1 advisor bug: backward crashed for any s < default block_k.
+        q, k, v = _qkv(b=1, h=2, s=64, d=16)
+
+        def f_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v) ** 2)
+
+        def f_ref(q, k, v):
+            return jnp.sum(mha_reference(q, k, v) ** 2)
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=5e-3, rtol=5e-3)
+
+    def test_unaligned_seq_gradients(self):
+        q, k, v = _qkv(b=1, h=1, s=200, d=16)
+
+        def f_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v) ** 2)
+
+        def f_ref(q, k, v):
+            return jnp.sum(mha_reference(q, k, v) ** 2)
+
+        g1 = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=5e-3, rtol=5e-3)
+
     def test_offsets_shift_mask(self):
         # with q_offset = S_k, every key is visible (no masking)
         q, k, v = _qkv(s=64)
