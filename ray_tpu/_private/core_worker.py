@@ -101,6 +101,7 @@ class CoreWorker:
         self.task_ctx = _TaskContext()
 
         self.io = rpc.EventLoopThread(name=f"rtpu-io-{mode}")
+        self.shutdown_event = threading.Event()
         self.memory_store = MemoryStore()
         self.ref_counter = ReferenceCounter(
             self.worker_id.binary(), self._on_out_of_scope, self._notify_owner
@@ -118,6 +119,15 @@ class CoreWorker:
         self.nodelet_conn: rpc.Connection = self.io.run(
             rpc.connect(*nodelet_addr, handlers=handlers, name="worker->nodelet")
         )
+        if mode == "worker":
+            # The nodelet owns this process's lifetime: if the connection
+            # drops (nodelet died / was SIGTERMed), exit instead of orphaning
+            # — an orphan holding the TPU chip wedges every later run.
+            self.nodelet_conn._on_close = lambda _c: self.shutdown_event.set()
+            if self.nodelet_conn.closed:
+                # Dropped in the window before the callback was attached (an
+                # already-closed connection never re-fires it).
+                self.shutdown_event.set()
         self.gcs_conn: rpc.Connection = self.io.run(
             rpc.connect(
                 *gcs_addr,
@@ -163,7 +173,6 @@ class CoreWorker:
             self._exec_queue = asyncio.Queue()
             self._dispatch_task = self.io.spawn(self._execute_loop())
 
-        self.shutdown_event = threading.Event()
         self._shut = False
 
     # ====================================================== setup / teardown
@@ -728,8 +737,18 @@ class CoreWorker:
                 return await self._invoke_async(spec, method)
             return await loop.run_in_executor(
                 self.executor_pool, self._invoke_sync, spec, method)
-        fn = self._load_function(spec)
-        return await loop.run_in_executor(self.executor_pool, self._invoke_sync, spec, fn)
+        # Function load included in the executor hop: on a cache miss it does
+        # a blocking kv_get, which would deadlock if run on the IO loop.
+        return await loop.run_in_executor(
+            self.executor_pool, self._invoke_normal_sync, spec)
+
+    def _invoke_normal_sync(self, spec: TaskSpec) -> dict:
+        try:
+            fn = self._load_function(spec)
+        except BaseException as e:
+            return {"status": "error",
+                    "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
+        return self._invoke_sync(spec, fn)
 
     def _create_actor_sync(self, spec: TaskSpec) -> dict:
         cls = self._load_function(spec)
@@ -775,7 +794,9 @@ class CoreWorker:
             loop = asyncio.get_event_loop()
             args, kwargs = await loop.run_in_executor(None, self._resolve_args, spec)
             out = await method(*args, **kwargs)
-            return self._pack_returns(spec, out)
+            # _pack_returns can block on plasma.put (large returns) — must not
+            # run on the IO loop it would be waiting on.
+            return await loop.run_in_executor(None, self._pack_returns, spec, out)
         except BaseException as e:
             return {"status": "error",
                     "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}

@@ -613,6 +613,10 @@ class Nodelet:
             # load, jax backend init); worker death surfaces as ConnectionLost.
             reply = await w.conn.call("push_task", msg["spec"], timeout=None)
             if reply.get("status") == "error":
+                # Kill the leased process too: _handle_worker_death only
+                # untracks it, and an untracked live worker is unreclaimable
+                # (reference kills the leased worker when creation fails).
+                self._kill_worker_proc(w)
                 await self._handle_worker_death(w, "actor constructor raised", report=False)
                 return {"ok": False, "reason": "actor constructor raised",
                         "error": reply.get("error")}
@@ -685,6 +689,8 @@ def main(argv=None):
     logging.basicConfig(level=logging.INFO, format="[nodelet] %(levelname)s %(message)s")
 
     async def run():
+        import signal
+
         nodelet = Nodelet(
             (args.gcs_host, args.gcs_port),
             resources=json.loads(args.resources) or None,
@@ -695,7 +701,15 @@ def main(argv=None):
         host, port = await nodelet.start(args.host, args.port)
         print(f"NODELET_PORT {port}", flush=True)
         print(f"NODELET_ID {nodelet.node_id.hex()}", flush=True)
-        await asyncio.Event().wait()
+        # Graceful SIGTERM/SIGINT: run Nodelet.stop() so spawned workers are
+        # killed rather than orphaned (Node.stop() SIGTERMs this process; a
+        # bare default handler would leak every worker).
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await nodelet.stop()
 
     try:
         asyncio.run(run())

@@ -340,10 +340,22 @@ class PlasmaClient:
     def release(self, oid: ObjectID) -> None:
         shm = self._mappings.pop(oid, None)
         if shm is not None:
-            try:
-                self._conn.call_sync("plasma_release", {"oid": oid.binary()})
-            except ConnectionError:
-                pass
+            if not self._conn.closed:
+                if self._io.on_loop_thread():
+                    # ObjectRef.__del__ can run ON the IO loop (e.g. a task
+                    # completion dropping the last hold); a blocking call_sync
+                    # here would deadlock the loop, so fire-and-forget the
+                    # release instead (the nodelet handles notify the same as
+                    # call, minus the reply).  A ConnectionLost inside the
+                    # spawned coroutine is dropped with its future — same
+                    # swallow-on-teardown behavior as the sync branch.
+                    self._io.spawn(
+                        self._conn.notify("plasma_release", {"oid": oid.binary()}))
+                else:
+                    try:
+                        self._conn.call_sync("plasma_release", {"oid": oid.binary()})
+                    except ConnectionError:
+                        pass
             # Close lazily: deserialized numpy arrays may alias this mapping.
             # POSIX keeps the pages alive until close; we close only when no
             # views exist, which we approximate by closing at release time if

@@ -393,6 +393,12 @@ class EventLoopThread:
         """Run a coroutine on the loop, blocking the calling thread."""
         return asyncio.run_coroutine_threadsafe(coro, self.loop).result(timeout)
 
+    def on_loop_thread(self) -> bool:
+        """True when the caller IS the IO-loop thread.  Any blocking call
+        (call_sync / run) from the loop thread deadlocks the loop — callers
+        use this to downgrade to fire-and-forget."""
+        return threading.current_thread() is self._thread
+
     def spawn(self, coro) -> "asyncio.Future":
         return asyncio.run_coroutine_threadsafe(coro, self.loop)
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -35,7 +36,12 @@ class Group:
         self.rank = rank
         self.core = worker_mod.require_core()
         self.seq = 0
-        self._inbox: Dict[tuple, Any] = {}
+        # key -> FIFO of payloads.  A queue (not a single slot) so two p2p
+        # sends with the same (src, tag) before the receiver consumes the
+        # first don't overwrite each other (round-1 advisor bug); message
+        # order per key is preserved by the single TCP connection + in-order
+        # handler dispatch.
+        self._inbox: Dict[tuple, deque] = {}
         self._inbox_cv = threading.Condition()
         self._member_addrs: Dict[int, tuple] = {}
         handler_name = f"col_{name}"
@@ -75,7 +81,7 @@ class Group:
     async def _on_message(self, conn, msg):
         key = (msg["seq"], msg["src"], msg.get("tag", 0))
         with self._inbox_cv:
-            self._inbox[key] = msg["data"]
+            self._inbox.setdefault(key, deque()).append(msg["data"])
             self._inbox_cv.notify_all()
         return True
 
@@ -89,13 +95,17 @@ class Group:
         key = (seq, rank, tag)
         deadline = time.monotonic() + RayConfig.collective_op_timeout_s
         with self._inbox_cv:
-            while key not in self._inbox:
+            while not self._inbox.get(key):
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise CollectiveError(
                         f"timeout waiting for rank {rank} in group {self.name!r}")
                 self._inbox_cv.wait(min(remaining, 1.0))
-            return self._inbox.pop(key)
+            q = self._inbox[key]
+            data = q.popleft()
+            if not q:
+                del self._inbox[key]
+            return data
 
     # ------------------------------------------------------------ primitives
     def allreduce(self, array, op: str = "sum"):

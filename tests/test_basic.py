@@ -20,6 +20,48 @@ def add(a, b):
 
 
 class TestTasks:
+    def test_release_last_ref_on_io_loop_no_deadlock(self, ray_start_regular):
+        """Regression (round-1 advisor): task completion releasing the last
+        Python ref to a plasma-mapped object ran ObjectRef.__del__ ->
+        plasma.release -> blocking call_sync ON the IO loop, hanging the
+        driver.  Repro: put big; get (maps shm); pass to task; del ref."""
+        big = np.zeros(2_000_000)  # large enough to go to plasma
+        ref = ray_tpu.put(big)
+        assert ray_tpu.get(ref, timeout=60).shape == big.shape  # map locally
+
+        @ray_tpu.remote
+        def consume(x):
+            return float(x.sum())
+
+        out = consume.remote(ref)
+        del ref  # the task's hold is now the last reference
+        assert ray_tpu.get(out, timeout=60) == 0.0
+        # driver loop still functional:
+        assert ray_tpu.get(add.remote(1, 1), timeout=60) == 2
+
+    def test_large_function_blob(self, ray_start_regular):
+        """Functions above the function-table threshold ship via GCS KV; the
+        worker-side kv_get must not run on (and deadlock) its IO loop."""
+        payload = bytes(900_000)
+
+        @ray_tpu.remote
+        def bigfn():
+            return len(payload)
+
+        assert ray_tpu.get(bigfn.remote(), timeout=120) == 900_000
+
+    def test_async_actor_large_return(self, ray_start_regular):
+        """Async actor methods returning plasma-bound objects must pack
+        returns off the IO loop (plasma.put blocks on it)."""
+
+        @ray_tpu.remote
+        class A:
+            async def big(self):
+                return np.ones(200_000)
+
+        a = A.remote()
+        assert ray_tpu.get(a.big.remote(), timeout=120).shape == (200_000,)
+
     def test_simple_task(self, ray_start_regular):
         assert ray_tpu.get(add.remote(1, 2), timeout=60) == 3
 
