@@ -31,8 +31,15 @@ def _session_dir() -> str:
 
 def _spawn_and_scrape(cmd, markers, log_path, env=None, timeout=120.0):
     """Start a subprocess, scrape `MARKER value` lines from stdout, then keep
-    draining stdout to a log file on a background thread."""
-    import select
+    draining stdout to a log file on a background thread.
+
+    A dedicated reader thread pumps lines into a queue for the whole process
+    lifetime.  (The previous select()-on-fd + readline() combination was
+    wrong: readline's TextIOWrapper slurps multiple lines off the pipe, so a
+    marker already sitting in the Python-side buffer never wakes select and
+    startup times out spuriously whenever two markers arrive in one chunk.)
+    """
+    import queue
 
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -40,41 +47,45 @@ def _spawn_and_scrape(cmd, markers, log_path, env=None, timeout=120.0):
     )
     found: Dict[str, str] = {}
     log_f = open(log_path, "a")
-    deadline = time.monotonic() + timeout
-    while len(found) < len(markers):
-        if proc.poll() is not None:
-            log_f.close()
-            raise RuntimeError(
-                f"process {cmd[:4]} exited with {proc.returncode} during startup; "
-                f"see {log_path}")
-        remaining = deadline - time.monotonic()
-        if remaining <= 0:
-            proc.kill()
-            raise TimeoutError(f"timed out waiting for {markers} from {cmd[:4]}")
-        # select so a silent-but-alive child cannot block startup forever.
-        ready, _, _ = select.select([proc.stdout], [], [], min(remaining, 0.5))
-        if not ready:
-            continue
-        line = proc.stdout.readline()
-        if not line:
-            continue
-        log_f.write(line)
-        log_f.flush()
-        parts = line.strip().split(" ", 1)
-        if parts and parts[0] in markers and len(parts) == 2:
-            found[parts[0]] = parts[1]
+    lines: "queue.Queue[Optional[str]]" = queue.Queue()
 
-    def drain():
+    def pump():
         try:
             for line in proc.stdout:
                 log_f.write(line)
                 log_f.flush()
+                lines.put(line)
         except ValueError:
             pass
         finally:
+            lines.put(None)  # EOF sentinel
             log_f.close()
 
-    threading.Thread(target=drain, daemon=True).start()
+    threading.Thread(target=pump, daemon=True).start()
+    deadline = time.monotonic() + timeout
+    while len(found) < len(markers):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            proc.kill()
+            raise TimeoutError(f"timed out waiting for {markers} from {cmd[:4]}")
+        try:
+            line = lines.get(timeout=min(remaining, 0.5))
+        except queue.Empty:
+            continue
+        if line is None:
+            # EOF: usually the child died; reap the exit code before
+            # formatting it.  A child that merely closed stdout while alive
+            # is killed — it could never deliver its markers anyway.
+            rc = proc.poll()
+            if rc is None:
+                proc.kill()
+                rc = proc.wait()
+            raise RuntimeError(
+                f"process {cmd[:4]} exited with {rc} during startup; "
+                f"see {log_path}")
+        parts = line.strip().split(" ", 1)
+        if parts and parts[0] in markers and len(parts) == 2:
+            found[parts[0]] = parts[1]
     return proc, found
 
 
