@@ -32,6 +32,12 @@ from jax.experimental import pallas as pl
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
+# Mosaic requires the last two dims of every block to tile as (8, 128) (or
+# equal the full array dim).  The lse output is logically (b*h, s_q) — rank-1
+# per grid step — so it is materialized with a trailing 128-lane dim and
+# sliced back to lane 0 after the call (same layout trick as
+# jax.experimental.pallas.ops.tpu.flash_attention's l/m residuals).
+LANES = 128
 
 
 def _on_tpu() -> bool:
@@ -119,7 +125,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, qo_ref, ko_ref, o_ref, lse_ref,
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[:] = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+    lse = jnp.where(l == 0.0, NEG_INF, m + jnp.log(l_safe))
+    lse_ref[:] = jnp.broadcast_to(lse[:, None], (block_q, LANES))
 
 
 def _round_up(x: int, m: int) -> int:
@@ -165,16 +172,16 @@ def _flash_forward(q, k, v, causal: bool, sm_scale: float, q_offset, k_offset,
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda bh, iq: (bh, iq, 0)),
-            pl.BlockSpec((None, block_q), lambda bh, iq: (bh, iq)),
+            pl.BlockSpec((None, block_q, LANES), lambda bh, iq: (bh, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s_q_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s_q_pad), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s_q_pad, LANES), jnp.float32),
         ],
         interpret=interpret,
     )(qr, kr, vr, qo, ko)
     out = out[:, :s_q]
-    lse = lse[:, :s_q]
+    lse = lse[:, :s_q, 0]
     return out.reshape(b, h, s_q, d), lse.reshape(b, h, s_q)
 
 
