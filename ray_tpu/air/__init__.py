@@ -1,0 +1,21 @@
+"""AIR glue: shared config/result dataclasses used by Train and Tune.
+
+Counterpart of the reference's ``ray.air`` (reference: python/ray/air/config.py,
+python/ray/air/result.py).
+"""
+
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result
+
+__all__ = [
+    "CheckpointConfig",
+    "FailureConfig",
+    "RunConfig",
+    "ScalingConfig",
+    "Result",
+]

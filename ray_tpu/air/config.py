@@ -1,0 +1,92 @@
+"""Shared Train/Tune config dataclasses.
+
+Counterpart of the reference's AIR configs (reference: python/ray/air/config.py —
+ScalingConfig, RunConfig, FailureConfig, CheckpointConfig).  TPU-first deltas:
+``use_tpu``/``tpus_per_worker`` instead of GPU knobs, and the default gang
+strategy for multi-host TPU groups is STRICT_SPREAD (one jax process per host;
+SURVEY §2.3 gang-scheduling row).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many training workers and what resources each gets.
+
+    Reference: python/ray/air/config.py ScalingConfig (num_workers,
+    use_gpu, resources_per_worker, placement_strategy).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: float = 0.0
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    def __post_init__(self):
+        if self.num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {self.num_workers}")
+        if self.use_tpu and self.tpus_per_worker == 0.0:
+            self.tpus_per_worker = 1.0
+
+    @property
+    def _worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.tpus_per_worker:
+            res["TPU"] = float(self.tpus_per_worker)
+        return res
+
+    def as_placement_group_bundles(self) -> List[Dict[str, float]]:
+        """One bundle per worker (the gang), reference:
+        ScalingConfig.as_placement_group_factory."""
+        return [dict(self._worker_resources) for _ in range(self.num_workers)]
+
+
+@dataclass
+class FailureConfig:
+    """Trial/run retry policy (reference: air/config.py FailureConfig).
+
+    max_failures: retries after a worker-group or trial crash; 0 = fail fast,
+    -1 = retry forever.
+    """
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Checkpoint retention (reference: air/config.py CheckpointConfig)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+    def __post_init__(self):
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be positive or None")
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+
+
+@dataclass
+class RunConfig:
+    """Run-level config: where results/checkpoints land and retry policy
+    (reference: air/config.py RunConfig)."""
+
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    stop: Optional[Dict[str, Any]] = None
+    verbose: int = 1
+
+    def __post_init__(self):
+        if self.storage_path is None:
+            self.storage_path = os.path.expanduser(
+                os.environ.get("RAY_TPU_STORAGE_PATH", "~/ray_tpu_results"))

@@ -1,0 +1,34 @@
+"""ray_tpu.train: distributed training orchestration.
+
+Counterpart of the reference's Ray Train (reference: python/ray/train/) —
+trainer → worker group of gang-scheduled actors → jax.distributed bring-up →
+user SPMD loop with report()/checkpointing.
+"""
+
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train._session import (
+    TrainContext,
+    get_checkpoint,
+    get_context,
+    report,
+)
+from ray_tpu.train.base_trainer import BaseTrainer, DataParallelTrainer
+from ray_tpu.train.jax_config import BackendConfig, JaxConfig
+from ray_tpu.train.jax_trainer import JaxTrainer
+from ray_tpu.train._backend_executor import TrainingFailedError
+
+__all__ = [
+    "BaseTrainer", "DataParallelTrainer", "JaxTrainer",
+    "BackendConfig", "JaxConfig",
+    "Checkpoint", "TrainContext", "TrainingFailedError",
+    "report", "get_checkpoint", "get_context",
+    "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
+    "Result",
+]

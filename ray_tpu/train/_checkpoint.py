@@ -1,0 +1,102 @@
+"""Checkpoint: a directory of files, addressable locally or in shared storage.
+
+Counterpart of the reference's ``ray.train.Checkpoint`` (reference:
+python/ray/train/_checkpoint.py:56 — directory + pyarrow.fs filesystem).
+TPU-first deltas: none needed at this layer — checkpoints are host-side
+artifacts; device state enters/leaves via the user's save/restore code (orbax
+or plain numpy) writing into the checkpoint directory.
+
+The filesystem seam is a tiny protocol (copy_dir/upload/download/exists)
+defaulting to the local filesystem, so a GCS/pyarrow.fs backend can slot in
+without touching callers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import shutil
+import tempfile
+from typing import Iterator, Optional
+
+
+class _LocalFileSystem:
+    """Default storage backend: plain local paths (NFS/gcsfuse included)."""
+
+    def merge_dir(self, local: str, remote: str) -> None:
+        """Copy contents into ``remote`` without removing what's there —
+        used when several ranks contribute to one checkpoint dir."""
+        os.makedirs(remote, exist_ok=True)
+        shutil.copytree(local, remote, dirs_exist_ok=True)
+
+    def download_dir(self, remote: str, local: str) -> None:
+        shutil.copytree(remote, local, dirs_exist_ok=True)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def delete_dir(self, path: str) -> None:
+        shutil.rmtree(path, ignore_errors=True)
+
+    def listdir(self, path: str):
+        return os.listdir(path)
+
+
+_DEFAULT_FS = _LocalFileSystem()
+
+
+class Checkpoint:
+    """A directory of files produced by training (reference:
+    train/_checkpoint.py:56).
+
+    Usage (inside a train loop)::
+
+        with tempfile.TemporaryDirectory() as d:
+            save_params(d, params)            # user serialization
+            train.report(metrics, checkpoint=Checkpoint.from_directory(d))
+
+    Restoring::
+
+        ckpt = train.get_checkpoint()
+        if ckpt:
+            with ckpt.as_directory() as d:
+                params = load_params(d)
+    """
+
+    def __init__(self, path: str, filesystem=None):
+        self.path = str(path)
+        self.filesystem = filesystem or _DEFAULT_FS
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(os.path.abspath(path))
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        """Yield a local directory with the checkpoint contents.  If the
+        checkpoint already lives on a local path, yields it directly (no
+        copy); otherwise downloads to a temp dir cleaned up on exit."""
+        if isinstance(self.filesystem, _LocalFileSystem) and os.path.isdir(self.path):
+            yield self.path
+            return
+        tmp = tempfile.mkdtemp(prefix="rtpu-ckpt-")
+        try:
+            self.filesystem.download_dir(self.path, tmp)
+            yield tmp
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        """Materialize into ``path`` (or a fresh temp dir) and return it."""
+        target = path or tempfile.mkdtemp(prefix="rtpu-ckpt-")
+        self.filesystem.download_dir(self.path, target)
+        return target
+
+    def __repr__(self):
+        return f"Checkpoint({self.path!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, Checkpoint) and other.path == self.path
+
+    def __hash__(self):
+        return hash(self.path)

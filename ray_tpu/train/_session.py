@@ -1,0 +1,200 @@
+"""Per-worker training session: runs the user loop, plumbs report().
+
+Counterpart of the reference's ``_TrainSession`` (reference:
+python/ray/train/_internal/session.py:111 init, :403 report, :667 the public
+``train.report``).  The user train loop runs on a daemon thread inside the
+train-worker actor; ``report(metrics, checkpoint)`` hands a result to the
+actor thread (which ships it to the driver) and blocks until consumed, so the
+loop and the driver stay in lockstep exactly like the reference.
+
+Checkpoint flow on report: the worker uploads the user's checkpoint dir to
+persistent storage *before* the result crosses the wire (reference:
+train/_internal/storage.py persist_current_checkpoint), so the driver only
+ever sees durable checkpoints.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ray_tpu.train._checkpoint import Checkpoint
+
+_session_lock = threading.Lock()
+_session: Optional["_TrainSession"] = None
+
+
+@dataclass
+class TrainContext:
+    """What a worker knows about its place in the gang (reference:
+    train/context.py TrainContext)."""
+
+    world_size: int = 1
+    world_rank: int = 0
+    local_rank: int = 0
+    local_world_size: int = 1
+    node_rank: int = 0
+    experiment_name: str = ""
+    trial_name: str = ""
+    trial_dir: str = ""
+
+    def get_world_size(self) -> int:
+        return self.world_size
+
+    def get_world_rank(self) -> int:
+        return self.world_rank
+
+    def get_local_rank(self) -> int:
+        return self.local_rank
+
+    def get_local_world_size(self) -> int:
+        return self.local_world_size
+
+    def get_node_rank(self) -> int:
+        return self.node_rank
+
+    def get_trial_dir(self) -> str:
+        return self.trial_dir
+
+    def get_experiment_name(self) -> str:
+        return self.experiment_name
+
+
+@dataclass
+class _TrainingResult:
+    """One report() payload from one worker."""
+
+    metrics: Dict[str, Any]
+    checkpoint_path: Optional[str] = None  # persisted path (storage), if any
+    final: bool = False                    # train fn returned
+    error: Optional[str] = None            # train fn raised (traceback text)
+
+
+class _TrainSession:
+    def __init__(self, train_fn, config: Dict[str, Any], context: TrainContext,
+                 starting_checkpoint: Optional[str] = None,
+                 checkpoint_seq_start: int = 0):
+        self.context = context
+        self.starting_checkpoint = starting_checkpoint
+        self._result_q: "queue.Queue[_TrainingResult]" = queue.Queue(maxsize=1)
+        self._consumed = threading.Semaphore(0)
+        # Continue numbering after any earlier attempt's checkpoints (passed
+        # by the driver): restarting at 0 would merge fresh state into stale
+        # same-numbered dirs.
+        self._checkpoint_seq = checkpoint_seq_start
+        self._thread = threading.Thread(
+            target=self._run, args=(train_fn, config), daemon=True,
+            name="train-loop")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    # ------------------------------------------------- train-loop side
+    def _run(self, train_fn, config) -> None:
+        try:
+            import inspect
+
+            sig = inspect.signature(train_fn)
+            if len(sig.parameters) >= 1:
+                train_fn(config)
+            else:
+                train_fn()
+            self._result_q.put(_TrainingResult(metrics={}, final=True))
+        except BaseException:
+            import traceback
+
+            self._result_q.put(_TrainingResult(
+                metrics={}, final=True, error=traceback.format_exc()))
+
+    def report(self, metrics: Dict[str, Any],
+               checkpoint: Optional[Checkpoint] = None) -> None:
+        """Called from the user loop.  Persists the checkpoint, enqueues the
+        result, and blocks until the actor thread consumed it."""
+        persisted = None
+        if checkpoint is not None:
+            persisted = self._persist_checkpoint(checkpoint)
+        self._result_q.put(_TrainingResult(dict(metrics), persisted))
+        self._consumed.acquire()  # lockstep with the driver (reference :403)
+
+    def _persist_checkpoint(self, checkpoint: Checkpoint) -> str:
+        seq = self._checkpoint_seq
+        self._checkpoint_seq += 1
+        ckpt_dir = os.path.join(self.context.trial_dir, f"checkpoint_{seq:06d}")
+        # Rank 0's files are the canonical checkpoint contents; nonzero ranks
+        # (sharded/model-parallel state) land in rank_<k>/ subdirs.  Merge
+        # (never replace) so concurrent rank uploads don't clobber each other;
+        # completeness is recorded by the driver in progress.json only after
+        # every rank's report round-trips, so a crash mid-upload can never
+        # yield a trusted half-checkpoint.
+        target = ckpt_dir if self.context.world_rank == 0 else os.path.join(
+            ckpt_dir, f"rank_{self.context.world_rank}")
+        checkpoint.filesystem.merge_dir(checkpoint.path, target)
+        return ckpt_dir
+
+    # ---------------------------------------------------- actor side
+    def get_next(self, timeout: Optional[float] = None) -> Optional[_TrainingResult]:
+        """Next result from the loop; None on timeout.  After a non-final
+        result is returned the loop is released to continue."""
+        try:
+            result = self._result_q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        if not result.final:
+            self._consumed.release()
+        return result
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+
+# ============================================================ public API
+def init_session(*args, **kwargs) -> _TrainSession:
+    global _session
+    with _session_lock:
+        if _session is not None and _session._thread.is_alive():
+            raise RuntimeError("a train session is already running in this process")
+        _session = _TrainSession(*args, **kwargs)
+        return _session
+
+
+def get_session() -> Optional[_TrainSession]:
+    return _session
+
+
+def shutdown_session() -> None:
+    global _session
+    with _session_lock:
+        _session = None
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) from a train worker
+    (reference: train/_internal/session.py:667 ``train.report``).  Outside a
+    session (plain script) it is a no-op print, so loops are portable."""
+    s = get_session()
+    if s is None:
+        print(f"[train.report] {metrics}")
+        return
+    s.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """The checkpoint to resume from, if the run was restored (reference:
+    train.get_checkpoint)."""
+    s = get_session()
+    if s is None or s.starting_checkpoint is None:
+        return None
+    return Checkpoint(s.starting_checkpoint)
+
+
+def get_context() -> TrainContext:
+    """World size/rank info inside a train worker (reference:
+    train/context.py get_context)."""
+    s = get_session()
+    if s is None:
+        return TrainContext()
+    return s.context

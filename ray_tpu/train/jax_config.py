@@ -1,0 +1,162 @@
+"""JaxConfig: bring up multi-process JAX on a train worker group.
+
+The TPU-critical backend (VERDICT r2 missing #1).  Counterpart of the
+reference's torch-xla process-group backend (reference:
+python/ray/train/torch/xla/config.py:20 TorchXLAConfig, :66-76
+_setup_xla_torch_process_group) re-designed for JAX's multi-controller model:
+every worker runs ``jax.distributed.initialize(coordinator, num_processes,
+process_id)``, after which ``jax.devices()`` is the GLOBAL device set and any
+jitted computation over a Mesh of those devices executes SPMD across the gang
+with XLA collectives riding ICI (TPU) or gloo (CPU tests).
+
+Worker placement → jax process mapping: world rank i = bundle i of the gang
+placement group; rank 0's node hosts the coordinator service on a free port.
+
+CPU test path: gloo collectives over N virtual devices per process — the same
+code path the multichip dryrun uses, so multi-host sharding is testable
+without a pod (SURVEY §4 takeaway (b)).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu.train._worker_group import WorkerGroup
+
+
+@dataclass
+class BackendConfig:
+    """Base backend config (reference: train/backend_config.py)."""
+
+    @property
+    def backend_cls(self):
+        return Backend
+
+
+class Backend:
+    """Framework hook points (reference: train/_internal/backend_executor.py
+    Backend.on_start/on_training_start/on_shutdown)."""
+
+    def on_start(self, worker_group: WorkerGroup, backend_config: BackendConfig):
+        pass
+
+    def on_training_start(self, worker_group: WorkerGroup,
+                          backend_config: BackendConfig):
+        pass
+
+    def on_shutdown(self, worker_group: WorkerGroup,
+                    backend_config: BackendConfig):
+        pass
+
+
+@dataclass
+class JaxConfig(BackendConfig):
+    """Backend config for JAX SPMD training.
+
+    platform: "tpu", "cpu", or None (auto: tpu when the worker detects chips,
+        else cpu).  The CPU path is the test substrate.
+    cpu_devices_per_worker: virtual host devices per process on the cpu
+        platform (xla_force_host_platform_device_count).
+    coordinator_port: fixed port for jax.distributed; default = a free port
+        picked on the rank-0 worker's node.
+    """
+
+    platform: Optional[str] = None
+    cpu_devices_per_worker: int = 1
+    coordinator_port: Optional[int] = None
+
+    @property
+    def backend_cls(self):
+        return _JaxBackend
+
+
+def _setup_jax_distributed(coordinator: str, num_processes: int,
+                           process_id: int, platform: Optional[str],
+                           cpu_devices_per_worker: int) -> dict:
+    """Runs INSIDE each train worker before any jax device use."""
+    import os
+
+    if platform is None:
+        from ray_tpu.accelerators import tpu_manager
+
+        platform = "tpu" if tpu_manager().get_current_node_num_accelerators() \
+            else "cpu"
+
+    if platform == "cpu":
+        # Replace (not append) any inherited device-count flag: workers
+        # inherit the driver/test env where it is pinned to 8.
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{cpu_devices_per_worker}").strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        # The TPU-VM site hook re-pins jax.config.jax_platforms after import;
+        # defeat it the same way _private/platform.py does.
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    else:
+        import jax
+
+    jax.distributed.initialize(coordinator, num_processes=num_processes,
+                               process_id=process_id)
+    return {
+        "process_id": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+        "platform": jax.default_backend(),
+    }
+
+
+def _teardown_jax_distributed() -> None:
+    import jax
+
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+class _JaxBackend(Backend):
+    def on_start(self, worker_group: WorkerGroup, backend_config: JaxConfig):
+        import ray_tpu
+
+        port = backend_config.coordinator_port or worker_group.execute_single(
+            0, _free_port)
+        coordinator = f"{worker_group.metadata[0].node_ip}:{port}"
+        n = len(worker_group)
+        refs = [
+            w.execute.remote(_setup_jax_distributed, coordinator, n, rank,
+                             backend_config.platform,
+                             backend_config.cpu_devices_per_worker)
+            for rank, w in enumerate(worker_group.workers)
+        ]
+        infos = ray_tpu.get(refs, timeout=120.0)
+        counts = {i["global_device_count"] for i in infos}
+        if len(counts) != 1:
+            raise RuntimeError(
+                f"jax.distributed came up inconsistent across the gang: {infos}")
+        self.device_info = infos[0]
+
+    def on_shutdown(self, worker_group: WorkerGroup,
+                    backend_config: JaxConfig):
+        import ray_tpu
+
+        try:
+            ray_tpu.get(worker_group.execute_async(_teardown_jax_distributed),
+                        timeout=10.0)
+        except Exception:
+            pass
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]

@@ -1,0 +1,56 @@
+"""JaxTrainer: distributed SPMD JAX training on the actor runtime.
+
+The TPU-native counterpart of the reference's ``TorchTrainer`` (reference:
+python/ray/train/torch/torch_trainer.py:11) with the process-group bring-up of
+the torch-xla backend (train/torch/xla/config.py:20).  Workers are
+gang-scheduled actors; each becomes one jax process of a multi-controller
+SPMD program (JaxConfig → jax.distributed.initialize), so inside
+``train_loop_per_worker`` the user sees the GLOBAL device set and shards with
+ordinary ``jax.sharding`` Meshes — collectives ride ICI, inserted by XLA, not
+by this framework (scaling-book recipe; SURVEY §2.3 DP row).
+
+Usage::
+
+    def train_loop(config):
+        import jax
+        mesh = jax.make_mesh((jax.device_count(),), ("dp",))
+        ...
+        for step in range(config["steps"]):
+            ...
+            train.report({"loss": float(loss)})
+
+    trainer = JaxTrainer(
+        train_loop,
+        train_loop_config={"steps": 100},
+        scaling_config=ScalingConfig(num_workers=4, use_tpu=True),
+    )
+    result = trainer.fit()
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ray_tpu.air.config import RunConfig, ScalingConfig
+from ray_tpu.train._checkpoint import Checkpoint
+from ray_tpu.train.base_trainer import DataParallelTrainer
+from ray_tpu.train.jax_config import JaxConfig
+
+
+class JaxTrainer(DataParallelTrainer):
+    _default_backend_config = JaxConfig()
+
+    def __init__(self, train_loop_per_worker: Callable,
+                 *, train_loop_config: Optional[Dict[str, Any]] = None,
+                 jax_config: Optional[JaxConfig] = None,
+                 scaling_config: Optional[ScalingConfig] = None,
+                 run_config: Optional[RunConfig] = None,
+                 resume_from_checkpoint: Optional[Checkpoint] = None):
+        super().__init__(
+            train_loop_per_worker,
+            train_loop_config=train_loop_config,
+            backend_config=jax_config or JaxConfig(),
+            scaling_config=scaling_config,
+            run_config=run_config,
+            resume_from_checkpoint=resume_from_checkpoint,
+        )

@@ -1,0 +1,5 @@
+"""ray_tpu.tune: experiment running (reference: python/ray/tune/)."""
+
+from ray_tpu.tune._single_trial import run_trainer_as_single_trial
+
+__all__ = ["run_trainer_as_single_trial"]

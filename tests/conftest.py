@@ -9,7 +9,16 @@ python/ray/tests/accelerators/test_tpu.py).
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO_ROOT = os.path.dirname(_TESTS_DIR)
+sys.path.insert(0, _REPO_ROOT)
+
+# Worker processes must be able to import test modules: cloudpickle serializes
+# module-level test functions BY REFERENCE (only __main__ goes by value), so a
+# task/actor defined in tests/test_x.py deserializes on a worker as
+# `import test_x`.  Spawned nodes/workers inherit this env.
+os.environ["PYTHONPATH"] = os.pathsep.join(
+    [_REPO_ROOT, _TESTS_DIR, os.environ.get("PYTHONPATH", "")]).rstrip(os.pathsep)
 
 # FORCE cpu: tests must never touch the real chip — the virtual 8-device CPU
 # mesh is the test substrate, and a wedged/contended TPU tunnel must not hang
