@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 
 @dataclass
@@ -26,13 +26,20 @@ class ScalingConfig:
     use_tpu: bool = False
     tpus_per_worker: float = 0.0
     resources_per_worker: Optional[Dict[str, float]] = None
-    placement_strategy: str = "PACK"
+    # None resolves to STRICT_SPREAD for TPU gangs (one jax process per
+    # host — two TPU processes packed on one host fight over the chips) and
+    # PACK otherwise (reference default).
+    placement_strategy: Optional[str] = None
 
     def __post_init__(self):
         if self.num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {self.num_workers}")
         if self.use_tpu and self.tpus_per_worker == 0.0:
             self.tpus_per_worker = 1.0
+        if self.placement_strategy is None:
+            self.placement_strategy = (
+                "STRICT_SPREAD" if (self.use_tpu or self.tpus_per_worker)
+                else "PACK")
 
     @property
     def _worker_resources(self) -> Dict[str, float]:
@@ -41,11 +48,6 @@ class ScalingConfig:
         if self.tpus_per_worker:
             res["TPU"] = float(self.tpus_per_worker)
         return res
-
-    def as_placement_group_bundles(self) -> List[Dict[str, float]]:
-        """One bundle per worker (the gang), reference:
-        ScalingConfig.as_placement_group_factory."""
-        return [dict(self._worker_resources) for _ in range(self.num_workers)]
 
 
 @dataclass
@@ -85,6 +87,9 @@ class RunConfig:
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
     stop: Optional[Dict[str, Any]] = None
     verbose: int = 1
+    # Max seconds between report() calls before the run is declared dead.
+    # Must cover the FIRST step's XLA compile (minutes on big TPU programs).
+    worker_report_timeout_s: float = 1800.0
 
     def __post_init__(self):
         if self.storage_path is None:

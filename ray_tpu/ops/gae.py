@@ -55,3 +55,25 @@ def gae_advantages(rewards, values, dones, gamma: float = 0.99,
     _, a_rev = jax.lax.associative_scan(_linrec_combine, (c_rev, d_rev), axis=0)
     adv = jnp.flip(a_rev, 0)
     return adv, adv + values
+
+
+def gae_from_fragments(rewards, values, next_values, dones,
+                       gamma: float = 0.99, gae_lambda: float = 0.95
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """GAE over fixed-length rollout fragments with auto-reset envs.
+
+    Unlike :func:`gae_advantages` (contiguous trajectory), the caller supplies
+    ``next_values`` explicitly — V(s_{t+1}) with 0 at terminations and
+    V(final pre-reset obs) at truncations (time-limit bootstrapping) — so the
+    scan is correct across episode boundaries inside a fragment.  dones =
+    terminated | truncated stops advantage propagation across the boundary.
+    All inputs (T,) or (T, K); same associative-scan lowering.
+    """
+    not_done = 1.0 - dones.astype(values.dtype)
+    deltas = rewards + gamma * next_values - values
+    c = gamma * gae_lambda * not_done
+    c_rev = jnp.flip(c, 0)
+    d_rev = jnp.flip(deltas, 0)
+    _, a_rev = jax.lax.associative_scan(_linrec_combine, (c_rev, d_rev), axis=0)
+    adv = jnp.flip(a_rev, 0)
+    return adv, adv + values

@@ -1,0 +1,11 @@
+"""ray_tpu.rllib: reinforcement learning on the actor runtime.
+
+Counterpart of the reference's RLlib new API stack (reference: rllib/ —
+EnvRunner actors sample on CPU, a JAX Learner updates on device, the
+Algorithm is the Tune-trainable driver loop).
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+
+__all__ = ["Algorithm", "AlgorithmConfig", "PPO", "PPOConfig"]

@@ -1,0 +1,107 @@
+"""Algorithm + AlgorithmConfig: the RL driver loop.
+
+Counterpart of the reference's Algorithm (reference:
+rllib/algorithms/algorithm.py:227 — a Tune Trainable whose ``train()`` runs
+one ``training_step`` and aggregates metrics; fluent AlgorithmConfig
+rllib/algorithms/algorithm_config.py).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any, Dict, Optional
+
+
+class AlgorithmConfig:
+    """Fluent config (reference: rllib/algorithms/algorithm_config.py).
+
+    cfg = (PPOConfig().environment("CartPole-v1")
+           .env_runners(num_env_runners=2).training(lr=3e-4))
+    """
+
+    def __init__(self):
+        self.env: Optional[str] = None
+        self.num_env_runners: int = 0
+        self.num_envs_per_env_runner: int = 8
+        self.rollout_fragment_length: int = 64
+        self.num_learners: int = 0
+        self.learner_platform: Optional[str] = None
+        self.seed: int = 0
+        self.model: Dict[str, Any] = {"hidden": (64, 64)}
+        self.training_params: Dict[str, Any] = {}
+
+    # ------------------------------------------------------ fluent setters
+    def environment(self, env: str) -> "AlgorithmConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, *, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        return self
+
+    def learners(self, *, num_learners: Optional[int] = None,
+                 platform: Optional[str] = None) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if platform is not None:
+            self.learner_platform = platform
+        return self
+
+    def training(self, **params) -> "AlgorithmConfig":
+        self.training_params.update(params)
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self) -> "Algorithm":
+        if self.env is None:
+            raise ValueError("config.environment(env_name) is required")
+        return self.algo_class(self)
+
+    @property
+    def algo_class(self):
+        raise NotImplementedError
+
+
+class Algorithm:
+    """reference: rllib/algorithms/algorithm.py:227 (step :896)."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._start_time = time.monotonic()
+        self.setup(config)
+
+    # subclasses override
+    def setup(self, config: AlgorithmConfig) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def train(self) -> Dict[str, Any]:
+        """One training iteration (reference: Trainable.train → step :896)."""
+        self.iteration += 1
+        results = self.training_step()
+        results.setdefault("training_iteration", self.iteration)
+        results.setdefault("time_total_s",
+                           time.monotonic() - self._start_time)
+        return results
+
+    def stop(self) -> None:
+        pass

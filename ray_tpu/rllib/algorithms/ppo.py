@@ -1,0 +1,119 @@
+"""PPO on the new-stack shapes: EnvRunner actors → JaxLearner → weight sync.
+
+Counterpart of the reference's PPO (reference: rllib/algorithms/ppo/ppo.py:67
+PPOConfig, :427 training_step: synchronous_parallel_sample →
+learner_group.update → env_runner_group.sync_weights :525).  The loss/GAE
+math lives in the jitted learner (core/learner.py); this module is the
+orchestration: parallel sampling on actor env-runners, one device update,
+broadcast weights through the object store.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import LearnerGroup
+from ray_tpu.rllib.env import make_vector_env
+from ray_tpu.rllib.env.env_runner import EnvRunner
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.training_params = {
+            "lr": 3e-4,
+            "gamma": 0.99,
+            "gae_lambda": 0.95,
+            "clip_param": 0.2,
+            "vf_loss_coeff": 0.5,
+            "vf_clip_param": 10.0,
+            "entropy_coeff": 0.0,
+            "num_epochs": 6,
+            "minibatch_size": 256,
+            "grad_clip": 0.5,
+        }
+
+    @property
+    def algo_class(self):
+        return PPO
+
+
+class PPO(Algorithm):
+    def setup(self, config: PPOConfig) -> None:
+        probe = make_vector_env(config.env, 1, seed=0)
+        self._module_spec = {
+            "observation_size": probe.observation_size,
+            "num_actions": probe.num_actions,
+            "hidden": tuple(config.model.get("hidden", (64, 64))),
+        }
+        self.learner_group = LearnerGroup(
+            self._module_spec, config.training_params,
+            num_learners=config.num_learners, seed=config.seed,
+            platform=config.learner_platform)
+
+        runner_args = dict(
+            env_name=config.env,
+            num_envs=config.num_envs_per_env_runner,
+            rollout_length=config.rollout_fragment_length,
+            module_spec=self._module_spec,
+        )
+        self._local_runner = None
+        self._runner_actors = []
+        if config.num_env_runners <= 0:
+            self._local_runner = EnvRunner(**runner_args, seed=config.seed)
+        else:
+            import ray_tpu
+
+            runner_cls = ray_tpu.remote(EnvRunner)
+            self._runner_actors = [
+                runner_cls.options(num_cpus=1).remote(
+                    **runner_args, seed=config.seed + 1000 * (i + 1))
+                for i in range(config.num_env_runners)
+            ]
+
+    # ------------------------------------------------------------ one iter
+    def training_step(self) -> Dict[str, Any]:
+        weights = self.learner_group.get_weights()
+
+        if self._local_runner is not None:
+            batches = [self._local_runner.sample(weights)]
+            metrics = [self._local_runner.get_metrics()]
+        else:
+            import ray_tpu
+
+            # ship weights once via the object store; every runner borrows
+            # the same copy (reference: sync_weights broadcast, ppo.py:525)
+            wref = ray_tpu.put(weights)
+            batches = ray_tpu.get(
+                [r.sample.remote(wref) for r in self._runner_actors])
+            metrics = ray_tpu.get(
+                [r.get_metrics.remote() for r in self._runner_actors])
+
+        batch = {k: np.concatenate([b[k] for b in batches], axis=1)
+                 for k in batches[0]}
+        stats = self.learner_group.update(batch)
+
+        returns = [m["episode_return_mean"] for m in metrics
+                   if np.isfinite(m["episode_return_mean"])]
+        return {
+            "episode_return_mean": float(np.mean(returns)) if returns
+            else float("nan"),
+            "num_env_steps_sampled_lifetime": int(
+                sum(m["num_env_steps_sampled_lifetime"] for m in metrics)),
+            "num_episodes": int(sum(m["num_episodes"] for m in metrics)),
+            **{f"learner/{k}": v for k, v in stats.items()},
+        }
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        self.learner_group.shutdown()
+        for r in self._runner_actors:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self._runner_actors = []

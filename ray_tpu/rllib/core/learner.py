@@ -1,0 +1,204 @@
+"""JaxLearner + LearnerGroup: the device-side update program.
+
+Counterpart of the reference's Learner stack (reference:
+rllib/core/learner/learner.py:116, torch_learner.py:61 compute/apply
+gradients :146,158, learner_group.py:83).  JAX-first redesign: the whole
+update — GAE (associative scan), minibatch epochs (lax.scan over shuffled
+minibatches), PPO loss, adam — is ONE jitted function; there is no
+per-minibatch Python loop or host↔device ping-pong.  On TPU the same jit
+runs on-chip; EnvRunners stay numpy/CPU (SURVEY §3.5).
+
+LearnerGroup: local mode (learner in-driver, the default for one device) or
+actor mode (Learner actors, weights synced via the object store).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import DiscretePolicyModule
+
+
+class JaxLearner:
+    def __init__(self, module_spec: Dict, config: Dict, seed: int = 0,
+                 platform: Optional[str] = None):
+        # platform="cpu" pins the learner off the accelerator (tests, or
+        # CPU-only clusters); None keeps the process default (TPU on chips).
+        if platform == "cpu":
+            from ray_tpu._private.platform import force_cpu_platform
+
+            force_cpu_platform(1)
+        import jax
+        import optax
+
+        self.module = DiscretePolicyModule(**module_spec)
+        self.config = dict(config)
+        self.params = self.module.init(jax.random.PRNGKey(seed))
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(self.config.get("grad_clip", 0.5)),
+            optax.adam(self.config.get("lr", 3e-4)),
+        )
+        self.opt_state = self.tx.init(self.params)
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._update = jax.jit(functools.partial(
+            _ppo_update, self.module, self.tx,
+            num_epochs=self.config.get("num_epochs", 6),
+            minibatch_size=self.config.get("minibatch_size", 256),
+            clip_param=self.config.get("clip_param", 0.2),
+            vf_loss_coeff=self.config.get("vf_loss_coeff", 0.5),
+            entropy_coeff=self.config.get("entropy_coeff", 0.0),
+            vf_clip_param=self.config.get("vf_clip_param", 10.0),
+            gamma=self.config.get("gamma", 0.99),
+            gae_lambda=self.config.get("gae_lambda", 0.95),
+        ))
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        """batch: time-major fragments (T, K, ...) concatenated over runners
+        along K, with next_values precomputed by the runners."""
+        import jax
+
+        self._key, sub = jax.random.split(self._key)
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.opt_state, batch, sub)
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+
+def _ppo_update(module, tx, params, opt_state, batch, key, *,
+                num_epochs, minibatch_size, clip_param, vf_loss_coeff,
+                entropy_coeff, vf_clip_param, gamma, gae_lambda):
+    """Whole PPO update under one jit (reference math:
+    rllib/algorithms/ppo/torch/ppo_torch_learner.py compute_loss_for_module)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.ops.gae import gae_from_fragments
+
+    dones = batch["terminated"] | batch["truncated"]
+    adv, targets = gae_from_fragments(
+        batch["rewards"], batch["values"], batch["next_values"],
+        dones, gamma, gae_lambda)
+
+    n = batch["rewards"].size
+    flat = {
+        "obs": batch["obs"].reshape(n, -1),
+        "actions": batch["actions"].reshape(n),
+        "logp_old": batch["logp"].reshape(n),
+        "adv": adv.reshape(n),
+        "targets": targets.reshape(n),
+        "values_old": batch["values"].reshape(n),
+    }
+    minibatch_size = min(minibatch_size, n)
+    num_minibatches = max(n // minibatch_size, 1)
+    used = num_minibatches * minibatch_size
+
+    def loss_fn(p, mb):
+        logp, entropy = module.logp_entropy(p, mb["obs"], mb["actions"])
+        ratio = jnp.exp(logp - mb["logp_old"])
+        a = mb["adv"]
+        a = (a - a.mean()) / (a.std() + 1e-8)  # per-minibatch adv norm
+        surrogate = jnp.minimum(
+            a * ratio, a * jnp.clip(ratio, 1 - clip_param, 1 + clip_param))
+        v = module.value(p, mb["obs"])
+        vf_err = jnp.clip((v - mb["targets"]) ** 2, 0.0, vf_clip_param)
+        loss = (-surrogate.mean() + vf_loss_coeff * vf_err.mean()
+                - entropy_coeff * entropy.mean())
+        return loss, {
+            "policy_loss": -surrogate.mean(),
+            "vf_loss": vf_err.mean(),
+            "entropy": entropy.mean(),
+            "approx_kl": (mb["logp_old"] - logp).mean(),
+        }
+
+    def epoch_body(carry, epoch_key):
+        p, s = carry
+        perm = jax.random.permutation(epoch_key, n)[:used] \
+            .reshape(num_minibatches, minibatch_size)
+
+        def mb_body(carry, idx):
+            p, s = carry
+            mb = {k: v[idx] for k, v in flat.items()}
+            (loss, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(p, mb)
+            updates, s = tx.update(grads, s, p)
+            p = optax.apply_updates(p, updates)
+            return (p, s), {**stats, "total_loss": loss}
+
+        (p, s), stats = jax.lax.scan(mb_body, (p, s), perm)
+        return (p, s), jax.tree_util.tree_map(jnp.mean, stats)
+
+    keys = jax.random.split(key, num_epochs)
+    (params, opt_state), stats = jax.lax.scan(
+        epoch_body, (params, opt_state), keys)
+    stats = jax.tree_util.tree_map(lambda x: x[-1], stats)  # last epoch
+    stats["mean_advantage"] = adv.mean()
+    stats["mean_value_target"] = targets.mean()
+    return params, opt_state, stats
+
+
+class LearnerGroup:
+    """Weight owner + update dispatcher (reference:
+    rllib/core/learner/learner_group.py:83).  num_learners=0 → local learner
+    in the driver process (the reference's default for single-device)."""
+
+    def __init__(self, module_spec: Dict, config: Dict, num_learners: int = 0,
+                 seed: int = 0, platform: Optional[str] = None):
+        self._local: Optional[JaxLearner] = None
+        self._actors: List = []
+        if num_learners <= 0:
+            self._local = JaxLearner(module_spec, config, seed, platform)
+        else:
+            import ray_tpu
+
+            learner_cls = ray_tpu.remote(JaxLearner)
+            self._actors = [
+                learner_cls.options(num_cpus=1).remote(module_spec, config,
+                                                       seed + i, platform)
+                for i in range(num_learners)
+            ]
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        if self._local is not None:
+            return self._local.update(batch)
+        import ray_tpu
+
+        # shard the batch over learner actors along the env axis (K); each
+        # learner updates independently and rank-0's weights win (single
+        # learner is the common case; multi-learner grad sync arrives with
+        # the collective-backed learner)
+        k = batch["rewards"].shape[1]
+        per = max(k // len(self._actors), 1)
+        shards = []
+        for i in range(len(self._actors)):
+            sl = slice(i * per, (i + 1) * per if i < len(self._actors) - 1 else k)
+            shards.append({key: v[:, sl] if v.ndim >= 2 else v
+                           for key, v in batch.items()})
+        stats = ray_tpu.get([a.update.remote(s)
+                             for a, s in zip(self._actors, shards)])
+        return stats[0]
+
+    def get_weights(self):
+        if self._local is not None:
+            return self._local.get_weights()
+        import ray_tpu
+
+        return ray_tpu.get(self._actors[0].get_weights.remote())
+
+    def shutdown(self) -> None:
+        import ray_tpu
+
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        self._actors = []

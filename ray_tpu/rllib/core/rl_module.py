@@ -1,0 +1,87 @@
+"""RLModule: the policy/value network as pure functions + a params pytree.
+
+Counterpart of the reference's RLModule (reference:
+rllib/core/rl_module/rl_module.py; default torch MLP in
+rllib/core/models/torch/...).  JAX-first: the module is a (init, apply) pair
+over an explicit params pytree — no stateful nn.Module — so the same
+functions run inside the Learner's jitted update and inside the (CPU) env
+runner's action computation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _init_mlp(key, sizes: Sequence[int], final_scale: float = 1.0):
+    """Tanh MLP params; final layer scaled down (policy heads want ~0 logits
+    at init so early exploration is uniform)."""
+    params = []
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i, k in enumerate(keys):
+        fan_in = sizes[i]
+        scale = final_scale if i == len(keys) - 1 else 1.0
+        w = jax.random.normal(k, (sizes[i], sizes[i + 1])) \
+            * scale / np.sqrt(fan_in)
+        params.append({"w": w.astype(jnp.float32),
+                       "b": jnp.zeros((sizes[i + 1],), jnp.float32)})
+    return params
+
+
+def _apply_mlp(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class DiscretePolicyModule:
+    """Separate policy/value tanh MLPs for discrete action spaces
+    (reference default: vf_share_layers=False)."""
+
+    def __init__(self, observation_size: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64)):
+        self.observation_size = observation_size
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, key) -> Dict:
+        kp, kv = jax.random.split(key)
+        sizes_pi = (self.observation_size, *self.hidden, self.num_actions)
+        sizes_vf = (self.observation_size, *self.hidden, 1)
+        return {"pi": _init_mlp(kp, sizes_pi, final_scale=0.01),
+                "vf": _init_mlp(kv, sizes_vf)}
+
+    # --------------------------------------------------------- forwards
+    def logits(self, params, obs) -> jnp.ndarray:
+        return _apply_mlp(params["pi"], obs)
+
+    def value(self, params, obs) -> jnp.ndarray:
+        return _apply_mlp(params["vf"], obs)[..., 0]
+
+    def forward_exploration(self, params, obs, key
+                            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Sample actions; returns (actions, logp, values)."""
+        logits = self.logits(params, obs)
+        actions = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)
+        logp_a = jnp.take_along_axis(logp, actions[..., None], -1)[..., 0]
+        return actions, logp_a, self.value(params, obs)
+
+    def forward_inference(self, params, obs) -> jnp.ndarray:
+        return jnp.argmax(self.logits(params, obs), axis=-1)
+
+    def logp_entropy(self, params, obs, actions
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        logits = self.logits(params, obs)
+        logp = jax.nn.log_softmax(logits)
+        logp_a = jnp.take_along_axis(logp, actions[..., None].astype(jnp.int32),
+                                     -1)[..., 0]
+        p = jnp.exp(logp)
+        entropy = -jnp.sum(p * logp, axis=-1)
+        return logp_a, entropy

@@ -1,0 +1,118 @@
+"""EnvRunner: CPU actor that samples fixed-length rollout fragments.
+
+Counterpart of the reference's SingleAgentEnvRunner (reference:
+rllib/env/single_agent_env_runner.py:131 sample; EnvRunnerGroup
+rllib/env/env_runner_group.py:71).  Each runner owns K vectorized envs and a
+copy of the policy params; ``sample()`` returns time-major arrays
+(T, K, ...) plus the value bootstrap for each fragment tail, ready for the
+Learner's GAE scan — no per-episode postprocessing on the driver
+(the reference's GAE-on-learner new-stack layout).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import DiscretePolicyModule
+from ray_tpu.rllib.env import make_vector_env
+
+
+class EnvRunner:
+    def __init__(self, env_name: str, num_envs: int, rollout_length: int,
+                 module_spec: Dict, seed: int = 0):
+        # Rollouts are a HOST program: policy inference here is tiny and
+        # latency-bound, so pin this process to the CPU backend.  Without
+        # this, the TPU-VM site hook pins jax at the device backend and every
+        # per-step dispatch crosses to the chip (observed: 270x slower).
+        # The Learner is the device program, not the runner (SURVEY §3.5).
+        from ray_tpu._private.platform import force_cpu_platform
+
+        force_cpu_platform(1)
+        import jax
+
+        self.env = make_vector_env(env_name, num_envs, seed=seed)
+        self.num_envs = num_envs
+        self.rollout_length = rollout_length
+        self.module = DiscretePolicyModule(**module_spec)
+        self.params = None
+        self._key = jax.random.PRNGKey(seed)
+        self.obs = self.env.reset()
+        # episode-return bookkeeping (reference: metrics on the EnvRunner)
+        self._ep_return = np.zeros(num_envs, np.float32)
+        self._recent_returns: collections.deque = collections.deque(maxlen=100)
+        self._lifetime_steps = 0
+
+        self._explore = jax.jit(self.module.forward_exploration)
+        self._value = jax.jit(self.module.value)
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def sample(self, weights=None) -> Dict[str, np.ndarray]:
+        """One fragment of rollout_length steps across all K envs."""
+        import jax
+
+        if weights is not None:
+            self.params = weights
+        assert self.params is not None, "set_weights before sample"
+        T, K = self.rollout_length, self.num_envs
+        out = {
+            "obs": np.empty((T, K, self.env.observation_size), np.float32),
+            "actions": np.empty((T, K), np.int32),
+            "logp": np.empty((T, K), np.float32),
+            "values": np.empty((T, K), np.float32),
+            "rewards": np.empty((T, K), np.float32),
+            "terminated": np.empty((T, K), bool),
+            "truncated": np.empty((T, K), bool),
+        }
+        final_obs = np.empty((T, K, self.env.observation_size), np.float32)
+        for t in range(T):
+            self._key, sub = jax.random.split(self._key)
+            actions, logp, values = self._explore(self.params, self.obs, sub)
+            actions = np.asarray(actions)
+            out["obs"][t] = self.obs
+            out["actions"][t] = actions
+            out["logp"][t] = np.asarray(logp)
+            out["values"][t] = np.asarray(values)
+            next_obs, rewards, terminated, truncated, info = \
+                self.env.step(actions)
+            out["rewards"][t] = rewards
+            out["terminated"][t] = terminated
+            out["truncated"][t] = truncated
+            final_obs[t] = info["final_obs"]
+
+            self._ep_return += rewards
+            for i in np.nonzero(terminated | truncated)[0]:
+                self._recent_returns.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+            self.obs = next_obs
+        self._lifetime_steps += T * K
+
+        # next_values[t] = V of the TRUE successor state: values[t+1] inside
+        # an episode, V(obs after the fragment) at the tail, 0 at termination,
+        # V(pre-reset final obs) at truncation (time-limit bootstrapping —
+        # truncation is not failure, the episode just stopped being observed).
+        tail_value = np.asarray(self._value(self.params, self.obs))
+        next_values = np.concatenate(
+            [out["values"][1:], tail_value[None]], axis=0)
+        next_values[out["terminated"]] = 0.0
+        if out["truncated"].any():
+            tr = np.nonzero(out["truncated"])
+            v_final = np.asarray(self._value(self.params, final_obs[tr]))
+            next_values[tr] = v_final
+        out["next_values"] = next_values.astype(np.float32)
+        return out
+
+    def get_metrics(self) -> Dict:
+        return {
+            "episode_return_mean": (float(np.mean(self._recent_returns))
+                                    if self._recent_returns else float("nan")),
+            "num_episodes": len(self._recent_returns),
+            "num_env_steps_sampled_lifetime": self._lifetime_steps,
+        }
+
+    def ping(self) -> bool:
+        return True

@@ -75,12 +75,23 @@ class TrainWorker:
 
 
 def _local_ip() -> str:
+    # UDP-connect trick needs no actual traffic, but a private-VPC host may
+    # have no route to 8.8.8.8 at all — fall back to the hostname's address
+    # before loopback (loopback as a coordinator address breaks every
+    # nonzero-rank host).
     try:
         with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
             s.connect(("8.8.8.8", 80))
             return s.getsockname()[0]
     except OSError:
-        return "127.0.0.1"
+        pass
+    try:
+        ip = socket.gethostbyname(socket.gethostname())
+        if not ip.startswith("127."):
+            return ip
+    except OSError:
+        pass
+    return "127.0.0.1"
 
 
 class WorkerGroup:

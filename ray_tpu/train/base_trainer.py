@@ -181,7 +181,8 @@ class DataParallelTrainer(BaseTrainer):
                 checkpoint_seq_start=_next_checkpoint_seq(trial_dir),
             )
             while True:
-                results = executor.get_next_results()
+                results = executor.get_next_results(
+                    timeout_s=self.run_config.worker_report_timeout_s)
                 if results is None:
                     break
                 rank0 = results[0]

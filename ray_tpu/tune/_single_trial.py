@@ -13,9 +13,15 @@ from __future__ import annotations
 import logging
 
 from ray_tpu.air.result import Result
+from ray_tpu.exceptions import RayError
 from ray_tpu.train._backend_executor import TrainingFailedError
 
 logger = logging.getLogger(__name__)
+
+# What a retry can plausibly fix: a worker crash mid-loop
+# (TrainingFailedError), a node/actor loss during worker-group bring-up
+# (RayError), or the gang not being schedulable yet (TimeoutError).
+_RETRYABLE = (TrainingFailedError, RayError, TimeoutError)
 
 
 def run_trainer_as_single_trial(trainer) -> Result:
@@ -27,7 +33,7 @@ def run_trainer_as_single_trial(trainer) -> Result:
     while True:
         try:
             return trainer.training_loop()
-        except TrainingFailedError as e:
+        except _RETRYABLE as e:
             attempt += 1
             if max_failures >= 0 and attempt > max_failures:
                 raise
