@@ -36,10 +36,10 @@ class ScalingConfig:
             raise ValueError(f"num_workers must be >= 1, got {self.num_workers}")
         if self.use_tpu and self.tpus_per_worker == 0.0:
             self.tpus_per_worker = 1.0
+        if self.tpus_per_worker and not self.use_tpu:
+            self.use_tpu = True  # the knobs imply each other
         if self.placement_strategy is None:
-            self.placement_strategy = (
-                "STRICT_SPREAD" if (self.use_tpu or self.tpus_per_worker)
-                else "PACK")
+            self.placement_strategy = "STRICT_SPREAD" if self.use_tpu else "PACK"
 
     @property
     def _worker_resources(self) -> Dict[str, float]:

@@ -24,15 +24,20 @@ def _linrec_combine(a, b):
     return c_a * c_b, d_b + c_b * d_a
 
 
+def _reverse_linrec(c, d):
+    """Solve y_t = c_t * y_{t+1} + d_t (y_{T} = 0) along axis 0."""
+    c_rev = jnp.flip(c, 0)
+    d_rev = jnp.flip(d, 0)
+    _, y_rev = jax.lax.associative_scan(_linrec_combine, (c_rev, d_rev), axis=0)
+    return jnp.flip(y_rev, 0)
+
+
 def discounted_returns(rewards, dones, gamma: float, bootstrap_value=None):
     """R_t = r_t + γ(1-done_t) R_{t+1}; rewards/dones: (T,) or (T, B)."""
     cont = gamma * (1.0 - dones.astype(rewards.dtype))
     last = jnp.zeros_like(rewards[-1]) if bootstrap_value is None else bootstrap_value
     d = rewards.at[-1].add(cont[-1] * last) if bootstrap_value is not None else rewards
-    c_rev = jnp.flip(cont, 0)
-    d_rev = jnp.flip(d, 0)
-    _, y_rev = jax.lax.associative_scan(_linrec_combine, (c_rev, d_rev), axis=0)
-    return jnp.flip(y_rev, 0)
+    return _reverse_linrec(cont, d)
 
 
 def gae_advantages(rewards, values, dones, gamma: float = 0.99,
@@ -49,11 +54,7 @@ def gae_advantages(rewards, values, dones, gamma: float = 0.99,
     next_values = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
     not_done = 1.0 - dones.astype(values.dtype)
     deltas = rewards + gamma * not_done * next_values - values
-    c = gamma * gae_lambda * not_done
-    c_rev = jnp.flip(c, 0)
-    d_rev = jnp.flip(deltas, 0)
-    _, a_rev = jax.lax.associative_scan(_linrec_combine, (c_rev, d_rev), axis=0)
-    adv = jnp.flip(a_rev, 0)
+    adv = _reverse_linrec(gamma * gae_lambda * not_done, deltas)
     return adv, adv + values
 
 
@@ -71,9 +72,5 @@ def gae_from_fragments(rewards, values, next_values, dones,
     """
     not_done = 1.0 - dones.astype(values.dtype)
     deltas = rewards + gamma * next_values - values
-    c = gamma * gae_lambda * not_done
-    c_rev = jnp.flip(c, 0)
-    d_rev = jnp.flip(deltas, 0)
-    _, a_rev = jax.lax.associative_scan(_linrec_combine, (c_rev, d_rev), axis=0)
-    adv = jnp.flip(a_rev, 0)
+    adv = _reverse_linrec(gamma * gae_lambda * not_done, deltas)
     return adv, adv + values

@@ -174,12 +174,15 @@ class LearnerGroup:
         # shard the batch over learner actors along the env axis (K); each
         # learner updates independently and rank-0's weights win (single
         # learner is the common case; multi-learner grad sync arrives with
-        # the collective-backed learner)
+        # the collective-backed learner).  More actors than env columns →
+        # the excess actors sit this round out (an empty shard would divide
+        # by zero inside the update).
         k = batch["rewards"].shape[1]
-        per = max(k // len(self._actors), 1)
+        n_active = min(len(self._actors), k)
+        per = k // n_active
         shards = []
-        for i in range(len(self._actors)):
-            sl = slice(i * per, (i + 1) * per if i < len(self._actors) - 1 else k)
+        for i in range(n_active):
+            sl = slice(i * per, (i + 1) * per if i < n_active - 1 else k)
             shards.append({key: v[:, sl] if v.ndim >= 2 else v
                            for key, v in batch.items()})
         stats = ray_tpu.get([a.update.remote(s)

@@ -28,9 +28,28 @@ class EnvRunner:
         # this, the TPU-VM site hook pins jax at the device backend and every
         # per-step dispatch crosses to the chip (observed: 270x slower).
         # The Learner is the device program, not the runner (SURVEY §3.5).
-        from ray_tpu._private.platform import force_cpu_platform
+        # Exception: if this process already initialized a jax backend (local
+        # debug mode sharing the driver with a learner), re-pinning is
+        # impossible — keep the existing backend and say so.
+        import sys
 
-        force_cpu_platform(1)
+        if "jax" in sys.modules:
+            import jax._src.xla_bridge as _xb
+
+            initialized = _xb.backends_are_initialized()
+        else:
+            initialized = False
+        if initialized:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "EnvRunner created after the jax backend initialized; "
+                "rollout inference shares that backend (use actor "
+                "env-runners for the CPU-rollout/device-learner split)")
+        else:
+            from ray_tpu._private.platform import force_cpu_platform
+
+            force_cpu_platform(1)
         import jax
 
         self.env = make_vector_env(env_name, num_envs, seed=seed)
@@ -100,9 +119,13 @@ class EnvRunner:
             [out["values"][1:], tail_value[None]], axis=0)
         next_values[out["terminated"]] = 0.0
         if out["truncated"].any():
+            # evaluate on the full fixed (T*K, obs) shape and index after:
+            # a data-dependent batch (the truncation count) would recompile
+            # the jit for every distinct count
             tr = np.nonzero(out["truncated"])
-            v_final = np.asarray(self._value(self.params, final_obs[tr]))
-            next_values[tr] = v_final
+            v_final = np.asarray(self._value(
+                self.params, final_obs.reshape(T * K, -1))).reshape(T, K)
+            next_values[tr] = v_final[tr]
         out["next_values"] = next_values.astype(np.float32)
         return out
 

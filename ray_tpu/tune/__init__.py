@@ -1,5 +1,17 @@
 """ray_tpu.tune: experiment running (reference: python/ray/tune/)."""
 
 from ray_tpu.tune._single_trial import run_trainer_as_single_trial
+from ray_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, Tuner
 
-__all__ = ["run_trainer_as_single_trial"]
+__all__ = [
+    "Tuner", "TuneConfig", "Trial", "ResultGrid",
+    "grid_search", "choice", "uniform", "loguniform", "randint",
+    "run_trainer_as_single_trial",
+]

@@ -1,0 +1,120 @@
+"""Search-space primitives + the basic variant generator.
+
+Counterpart of the reference's search space API (reference:
+python/ray/tune/search/sample.py — tune.grid_search/choice/uniform;
+variant generation tune/search/basic_variant.py).  Minimal but same shapes:
+grid_search expands cartesian; samplers draw per num_samples.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Dict, List
+
+
+class _GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+class _Sampler:
+    def sample(self, rng: random.Random):
+        raise NotImplementedError
+
+
+class _Choice(_Sampler):
+    def __init__(self, values):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return rng.choice(self.values)
+
+
+class _Uniform(_Sampler):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class _LogUniform(_Sampler):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+class _Randint(_Sampler):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+def grid_search(values) -> _GridSearch:
+    return _GridSearch(values)
+
+
+def choice(values) -> _Choice:
+    return _Choice(values)
+
+
+def uniform(low: float, high: float) -> _Uniform:
+    return _Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> _LogUniform:
+    return _LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> _Randint:
+    return _Randint(low, high)
+
+
+def generate_variants(param_space: Dict[str, Any], num_samples: int = 1,
+                      seed: int = 0) -> List[Dict[str, Any]]:
+    """Expand a param space into concrete trial configs: the cartesian
+    product of every grid_search, repeated num_samples times with samplers
+    re-drawn each repeat (reference: basic_variant.py semantics)."""
+    rng = random.Random(seed)
+
+    grid_keys: List[str] = []
+    grid_values: List[List[Any]] = []
+
+    def find_grids(space, prefix=""):
+        for k, v in space.items():
+            path = f"{prefix}{k}"
+            if isinstance(v, _GridSearch):
+                grid_keys.append(path)
+                grid_values.append(v.values)
+            elif isinstance(v, dict):
+                find_grids(v, f"{path}/")
+
+    find_grids(param_space)
+
+    def materialize(space, assignment, prefix=""):
+        out = {}
+        for k, v in space.items():
+            path = f"{prefix}{k}"
+            if isinstance(v, _GridSearch):
+                out[k] = assignment[path]
+            elif isinstance(v, _Sampler):
+                out[k] = v.sample(rng)
+            elif isinstance(v, dict):
+                out[k] = materialize(v, assignment, f"{path}/")
+            else:
+                out[k] = v
+        return out
+
+    combos = list(itertools.product(*grid_values)) if grid_keys else [()]
+    variants = []
+    for _ in range(max(num_samples, 1)):
+        for combo in combos:
+            assignment = dict(zip(grid_keys, combo))
+            variants.append(materialize(param_space, assignment))
+    return variants

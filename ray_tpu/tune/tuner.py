@@ -1,0 +1,247 @@
+"""Tuner: concurrent fault-tolerant trial execution.
+
+Counterpart of the reference's Tuner/TuneController (reference:
+python/ray/tune/tuner.py:44, fit :344; tune/execution/tune_controller.py:68).
+Redesign: each trial runs as a remote TASK in its own worker process — a
+function trainable runs directly; a Trainer trainable becomes a nested trial
+driver that builds its own gang-scheduled worker group (the reference's
+trial-actor → BackendExecutor layering, collapsed by one level).  The
+controller is an event loop over ``ray_tpu.wait`` with per-trial retry
+bookkeeping (FailureConfig.max_failures).
+
+Experiment state is snapshotted to <storage>/<name>/tuner_state.json after
+every trial transition (reference: tune/execution/experiment_state.py:61).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import ray_tpu
+from ray_tpu.air.config import RunConfig
+from ray_tpu.air.result import Result
+from ray_tpu.exceptions import RayError
+from ray_tpu.tune.search import generate_variants
+
+
+@dataclass
+class TuneConfig:
+    """reference: tune/tune_config.py."""
+
+    num_samples: int = 1
+    max_concurrent_trials: int = 2
+    metric: Optional[str] = None
+    mode: str = "max"
+    trial_resources: Dict[str, float] = field(default_factory=lambda: {"CPU": 1.0})
+
+    def __post_init__(self):
+        if self.mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+
+
+@dataclass
+class Trial:
+    """reference: tune/experiment/trial.py (state machine subset)."""
+
+    index: int
+    config: Dict[str, Any]
+    name: str
+    status: str = "PENDING"  # PENDING | RUNNING | TERMINATED | ERROR
+    num_failures: int = 0
+    result: Optional[Result] = None
+    error: Optional[str] = None
+
+
+class ResultGrid:
+    """reference: tune/result_grid.py."""
+
+    def __init__(self, results: List[Result], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    @property
+    def errors(self) -> List[Exception]:
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("pass metric= (or set TuneConfig.metric)")
+        ok = [r for r in self._results
+              if r.error is None and metric in r.metrics]
+        if not ok:
+            raise RuntimeError("no successful trial reported "
+                               f"metric {metric!r}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return max(ok, key=key) if mode == "max" else min(ok, key=key)
+
+    def get_dataframe(self):
+        rows = [dict(r.metrics, trial_path=r.path) for r in self._results
+                if r.error is None]
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(rows)
+        except ImportError:
+            return rows
+
+
+def _run_function_trial(fn: Callable, config: Dict[str, Any],
+                        trial_dir: str) -> Dict[str, Any]:
+    """Task body for a function trainable: returns its final metrics dict."""
+    os.makedirs(trial_dir, exist_ok=True)
+    out = fn(config)
+    if out is None:
+        out = {}
+    if not isinstance(out, dict):
+        raise TypeError(
+            f"function trainable must return a metrics dict, got {type(out)}")
+    return out
+
+
+def _run_trainer_trial(trainer_blob: bytes, config: Dict[str, Any],
+                       trial_name: str) -> Dict[str, Any]:
+    """Task body for a Trainer trainable: this worker process becomes the
+    trial driver — it deserializes the trainer, merges the trial config into
+    train_loop_config, and runs fit() (which builds its own worker group)."""
+    import cloudpickle
+
+    trainer = cloudpickle.loads(trainer_blob)
+    trainer.train_loop_config = {**trainer.train_loop_config, **config}
+    trainer.run_config.name = trial_name
+    result = trainer.fit()
+    return {"_metrics": result.metrics, "_path": result.path,
+            "_checkpoint": result.checkpoint.path if result.checkpoint else None,
+            "_history": result.metrics_history}
+
+
+class Tuner:
+    def __init__(self, trainable: Union[Callable, Any], *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = copy.deepcopy(run_config) if run_config else RunConfig()
+        if self._run_config.name is None:
+            self._run_config.name = \
+                f"tune_{time.strftime('%Y-%m-%d_%H-%M-%S')}_{uuid.uuid4().hex[:6]}"
+
+    # ------------------------------------------------------------------ fit
+    def fit(self) -> ResultGrid:
+        from ray_tpu.train.base_trainer import BaseTrainer
+
+        is_trainer = isinstance(self._trainable, BaseTrainer)
+        variants = generate_variants(self._param_space,
+                                     self._tune_config.num_samples)
+        exp_dir = os.path.join(
+            os.path.expanduser(self._run_config.storage_path),
+            self._run_config.name)
+        os.makedirs(exp_dir, exist_ok=True)
+        trials = [
+            Trial(index=i, config=v, name=f"trial_{i:05d}")
+            for i, v in enumerate(variants)
+        ]
+
+        if is_trainer:
+            import cloudpickle
+
+            base = copy.deepcopy(self._trainable)
+            base.run_config = copy.deepcopy(self._run_config)
+            base.run_config.storage_path = exp_dir
+            # per-trial retries happen inside the nested fit(); the
+            # controller-level retry below handles process/node loss
+            trainer_blob = cloudpickle.dumps(base)
+
+        max_failures = self._run_config.failure_config.max_failures
+        remote_opts = {"num_cpus":
+                       self._tune_config.trial_resources.get("CPU", 1.0),
+                       "max_retries": 0}
+        extra = {k: v for k, v in self._tune_config.trial_resources.items()
+                 if k != "CPU"}
+        if extra:
+            remote_opts["resources"] = extra
+
+        fn_task = ray_tpu.remote(_run_function_trial).options(**remote_opts)
+        tr_task = ray_tpu.remote(_run_trainer_trial).options(**remote_opts)
+
+        def submit(trial: Trial):
+            trial.status = "RUNNING"
+            if is_trainer:
+                return tr_task.remote(trainer_blob, trial.config, trial.name)
+            return fn_task.remote(self._trainable, trial.config,
+                                  os.path.join(exp_dir, trial.name))
+
+        pending = list(trials)
+        running: Dict[Any, Trial] = {}
+        while pending or running:
+            while pending and len(running) < \
+                    self._tune_config.max_concurrent_trials:
+                t = pending.pop(0)
+                running[submit(t)] = t
+            ready, _ = ray_tpu.wait(list(running), num_returns=1)
+            ref = ready[0]
+            trial = running.pop(ref)
+            try:
+                out = ray_tpu.get(ref)
+            except (RayError, Exception) as e:  # noqa: B902
+                trial.num_failures += 1
+                if max_failures < 0 or trial.num_failures <= max_failures:
+                    pending.append(trial)
+                    trial.status = "PENDING"
+                else:
+                    trial.status = "ERROR"
+                    trial.error = repr(e)
+                    trial.result = Result(
+                        metrics={"config": trial.config}, error=e,
+                        path=os.path.join(exp_dir, trial.name))
+                self._snapshot(exp_dir, trials)
+                continue
+            trial.status = "TERMINATED"
+            if is_trainer:
+                from ray_tpu.train._checkpoint import Checkpoint
+
+                trial.result = Result(
+                    metrics={**out["_metrics"], "config": trial.config},
+                    checkpoint=(Checkpoint(out["_checkpoint"])
+                                if out["_checkpoint"] else None),
+                    path=out["_path"],
+                    metrics_history=out["_history"])
+            else:
+                trial.result = Result(
+                    metrics={**out, "config": trial.config},
+                    path=os.path.join(exp_dir, trial.name))
+            self._snapshot(exp_dir, trials)
+
+        return ResultGrid([t.result for t in trials],
+                          self._tune_config.metric, self._tune_config.mode)
+
+    def _snapshot(self, exp_dir: str, trials: List[Trial]) -> None:
+        tmp = os.path.join(exp_dir, "tuner_state.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump({
+                "time": time.time(),
+                "trials": [{
+                    "name": t.name, "status": t.status,
+                    "num_failures": t.num_failures, "error": t.error,
+                    "config": {k: repr(v) for k, v in t.config.items()},
+                } for t in trials],
+            }, f, indent=2)
+        os.replace(tmp, os.path.join(exp_dir, "tuner_state.json"))

@@ -1,0 +1,127 @@
+"""Tune tests: grid expansion, concurrent trials, retry, Tuner(trainer).
+
+Reference semantics: tune/tuner.py:344 fit, tune_controller retries
+(VERDICT r2 next-step #5 done-criterion: a 4-trial grid with one injected
+trial failure completing).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air.config import FailureConfig, RunConfig
+from ray_tpu.tune import TuneConfig, Tuner
+from ray_tpu.tune.search import generate_variants
+
+
+def test_generate_variants_grid_and_samplers():
+    space = {
+        "lr": tune.grid_search([1e-3, 1e-4]),
+        "wd": tune.grid_search([0.0, 0.1]),
+        "hidden": 64,
+        "drop": tune.uniform(0.0, 0.5),
+    }
+    variants = generate_variants(space, num_samples=1)
+    assert len(variants) == 4
+    assert {(v["lr"], v["wd"]) for v in variants} == {
+        (1e-3, 0.0), (1e-3, 0.1), (1e-4, 0.0), (1e-4, 0.1)}
+    assert all(v["hidden"] == 64 for v in variants)
+    assert all(0.0 <= v["drop"] <= 0.5 for v in variants)
+    # num_samples repeats the grid
+    assert len(generate_variants(space, num_samples=3)) == 12
+
+
+def _trainable(config):
+    # quadratic: best at x=3
+    return {"score": -(config["x"] - 3) ** 2}
+
+
+def _flaky_trainable(config):
+    """Fails on the first attempt of x==2 only (marker file = attempt log)."""
+    marker = os.path.join(config["dir"], f"attempt_{config['x']}")
+    if config["x"] == 2 and not os.path.exists(marker):
+        open(marker, "w").close()
+        raise RuntimeError("injected trial failure")
+    return {"score": -(config["x"] - 3) ** 2}
+
+
+def test_tuner_grid_with_injected_failure(ray_start_regular, tmp_path):
+    """4-trial grid; one trial fails once and is retried to completion."""
+    tuner = Tuner(
+        _flaky_trainable,
+        param_space={"x": tune.grid_search([1, 2, 3, 4]),
+                     "dir": str(tmp_path)},
+        tune_config=TuneConfig(num_samples=1, max_concurrent_trials=2,
+                               metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)),
+    )
+    results = tuner.fit()
+    assert len(results) == 4
+    assert not results.errors
+    best = results.get_best_result()
+    assert best.metrics["config"]["x"] == 3
+    assert best.metrics["score"] == 0
+
+
+def test_tuner_failure_exhausts_retries(ray_start_regular, tmp_path):
+    def always_fails(config):
+        raise ValueError("hopeless")
+
+    tuner = Tuner(
+        always_fails,
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)),
+    )
+    results = tuner.fit()
+    assert len(results.errors) == 2
+    with pytest.raises(RuntimeError, match="no successful trial"):
+        results.get_best_result()
+    # experiment snapshot recorded the terminal states
+    import json
+
+    exp_dir = os.path.join(str(tmp_path), tuner._run_config.name)
+    state = json.load(open(os.path.join(exp_dir, "tuner_state.json")))
+    assert all(t["status"] == "ERROR" and t["num_failures"] == 2
+               for t in state["trials"])
+
+
+def _tiny_train_loop(config):
+    """Per-worker loop for the Tuner(trainer) path: 'loss' depends on lr so
+    the grid has a best point."""
+    from ray_tpu import train
+
+    for i in range(2):
+        train.report({"loss": (config["lr"] - 3) ** 2 + i * 0.0, "step": i})
+
+
+def test_tuner_over_jax_trainer(ray_start_regular, tmp_path):
+    """Tuner(JaxTrainer) grid: each trial is a nested trial-driver task that
+    builds its own worker group (reference: trainer fit routes through Tune,
+    base_trainer.py:577-623 — here inverted: Tune drives trainers)."""
+    from ray_tpu.train import JaxConfig, JaxTrainer, ScalingConfig
+
+    trainer = JaxTrainer(
+        _tiny_train_loop,
+        jax_config=JaxConfig(platform="cpu", cpu_devices_per_worker=1),
+        scaling_config=ScalingConfig(num_workers=1),
+    )
+    tuner = Tuner(
+        trainer,
+        param_space={"lr": tune.grid_search([1.0, 3.0])},
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               max_concurrent_trials=1),
+        run_config=RunConfig(storage_path=str(tmp_path)),
+    )
+    results = tuner.fit()
+    assert len(results) == 2
+    assert not results.errors
+    best = results.get_best_result()
+    assert best.metrics["config"]["lr"] == 3.0
+    assert best.metrics["loss"] == 0.0
+    assert len(best.metrics_history) == 2
