@@ -25,6 +25,7 @@ python/ray/_private/ray_perf.py.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 # bf16 peak FLOPs/s per chip by TPU generation (public spec sheets).
@@ -152,6 +153,45 @@ def main() -> None:
         result["timing_note"] = (
             "mfu>1.0: backend completion timing not chip-accurate; "
             "wall-clock numbers reported as measured")
+
+    # Core-runtime microbenchmarks (reference: ray_perf.py / BASELINE.md),
+    # in a subprocess so runtime processes can't disturb the TPU number and
+    # a runtime bug can't take down the headline line.
+    if os.environ.get("RAY_TPU_BENCH_MICRO", "1") != "0":
+        import subprocess
+        import sys
+
+        code = ("import json, ray_tpu; from ray_tpu._private.ray_perf import "
+                "run_microbenchmarks; "
+                "ray_tpu.init(num_cpus=4, object_store_memory=1024**3); "
+                "print('MICRO=' + json.dumps(run_microbenchmarks()))")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        try:
+            # own process group: on timeout the WHOLE runtime tree (gcs,
+            # nodelet, workers + their shm store) must die, not just the
+            # direct child
+            proc = subprocess.Popen([sys.executable, "-c", code],
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True,
+                                    env=env, start_new_session=True)
+            try:
+                stdout, stderr = proc.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                import signal
+
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait()
+                raise
+            for line in stdout.splitlines():
+                if line.startswith("MICRO="):
+                    result["micro"] = json.loads(line[len("MICRO="):])
+                    break
+            else:
+                result["micro_error"] = (stderr or "no output")[-500:]
+        except Exception as e:
+            result["micro_error"] = repr(e)
+
     print(json.dumps(result))
 
 

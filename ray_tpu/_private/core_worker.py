@@ -238,7 +238,7 @@ class CoreWorker:
         oid = self._next_put_id()
         self.ref_counter.add_owned(oid, initial_local=0)
         if ser.total_bytes() > RayConfig.max_direct_call_object_size:
-            self.plasma.put(oid, memoryview(ser.to_bytes()))
+            self.plasma.put_serialized(oid, ser)
             self.memory_store.put(oid, IN_PLASMA)
             with self._refs_lock:
                 self._owned_in_plasma.add(oid)
@@ -816,7 +816,7 @@ class CoreWorker:
         for oid, value in zip(spec.return_ids(), outs):
             ser = self.ctx.serialize(value)
             if ser.total_bytes() > RayConfig.max_direct_call_object_size:
-                self.plasma.put(oid, memoryview(ser.to_bytes()))
+                self.plasma.put_serialized(oid, ser)
                 returns.append((oid.binary(), "plasma", ser.total_bytes()))
             else:
                 returns.append((oid.binary(), "val", ser.inband,
@@ -848,6 +848,10 @@ class NormalTaskSubmitter:
         if st is None:
             st = self.classes[key] = {
                 "pending": deque(), "idle": [], "inflight": 0, "busy": 0,
+                # outstanding lease-request token -> nodelet conn, so a
+                # drained queue can cancel them (otherwise the nodelet keeps
+                # spawning workers for demand that no longer exists)
+                "tokens": {},
             }
         return st
 
@@ -899,8 +903,24 @@ class NormalTaskSubmitter:
         for _ in range(max(want, 0)):
             st["inflight"] += 1
             asyncio.get_event_loop().create_task(self._request_lease(key, st))
-        if not st["pending"] and not st["busy"]:
-            await self._return_idle(st)
+        if not st["pending"]:
+            self._cancel_outstanding_leases(st)
+            if not st["busy"]:
+                await self._return_idle(st)
+
+    def _cancel_outstanding_leases(self, st) -> None:
+        """Queue drained: tell nodelets to drop our still-queued lease
+        requests (reference: CancelWorkerLease on queue drain)."""
+        by_conn: Dict[object, list] = {}
+        for token, conn in st["tokens"].items():
+            by_conn.setdefault(conn, []).append(token)
+        for conn, tokens in by_conn.items():
+            async def _fire(conn=conn, tokens=tokens):
+                try:
+                    await conn.call("cancel_lease_requests", {"tokens": tokens})
+                except (ConnectionError, asyncio.TimeoutError, rpc.ConnectionLost):
+                    pass
+            asyncio.get_event_loop().create_task(_fire())
 
     async def _return_idle(self, st):
         while st["idle"]:
@@ -952,7 +972,10 @@ class NormalTaskSubmitter:
         return conn
 
     async def _request_lease(self, key, st):
+        import uuid
+
         outcome = "done"  # "done" | "granted" | "retry"
+        token = uuid.uuid4().hex
         try:
             if not st["pending"]:
                 return
@@ -965,9 +988,16 @@ class NormalTaskSubmitter:
             conn = await self._lease_target(spec)
             msg = {"resources": spec.resources,
                    "strategy": {"kind": s.kind, "node_id": s.node_id, "soft": s.soft},
-                   "bundle": bundle, "spillback_count": 0}
+                   "bundle": bundle, "spillback_count": 0, "token": token}
             for _ in range(8):  # bounded spillback chain
+                st["tokens"][token] = conn
                 resp = await conn.call("request_worker_lease", msg, timeout=None)
+                if resp["type"] == "cancelled":
+                    # a task submitted during the cancel round-trip may be
+                    # waiting on this slot — re-pump or it never gets a lease
+                    outcome = "cancelled"
+                    return
+                st["tokens"].pop(token, None)
                 if resp["type"] == "granted":
                     worker_conn = await self._worker_conn(tuple(resp["worker_addr"]))
                     lease = {"lease_id": resp["lease_id"], "worker_conn": worker_conn,
@@ -992,11 +1022,12 @@ class NormalTaskSubmitter:
                 logger.warning("lease request failed (will retry): %r", e)
                 outcome = "retry"
         finally:
+            st["tokens"].pop(token, None)
             st["inflight"] -= 1
             if outcome != "done":
                 # "granted": pump to dispatch onto the new lease.
-                # "retry": without a re-pump, this class's pending tasks would
-                # never get another lease request.
+                # "retry"/"cancelled": without a re-pump, this class's pending
+                # tasks would never get another lease request.
                 async def _followup():
                     if outcome == "retry":
                         await asyncio.sleep(0.2)

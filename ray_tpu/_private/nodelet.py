@@ -40,6 +40,10 @@ from ray_tpu._private.object_store import PlasmaStore, register_store_handlers
 logger = logging.getLogger(__name__)
 
 
+class _LeaseCancelled(Exception):
+    """A queued lease request was cancelled by its client."""
+
+
 class WorkerHandle:
     __slots__ = ("worker_id", "proc", "conn", "addr", "pid", "state", "lease_id",
                  "is_actor", "started_at", "idle_since")
@@ -106,6 +110,11 @@ class Nodelet:
         self.leases: Dict[int, dict] = {}
         self._lease_seq = 0
         self._queued_leases: deque = deque()  # (msg, future) waiting for resources
+        # client token -> the future its lease request currently waits on
+        # (resource queue or worker pop); cancellation resolves it with
+        # _LeaseCancelled (reference: CancelWorkerLease,
+        # normal_task_submitter.cc lease cancellation on queue drain)
+        self._lease_waiters: Dict[str, asyncio.Future] = {}
 
         self.bundles: Dict[Tuple[bytes, int], Bundle] = {}
 
@@ -350,12 +359,16 @@ class Nodelet:
             w = idle[0]
             w.state = "leased"
             fut.set_result(w)
-        # Maintain pipeline: spawn if demand outstrips starting workers.
-        deficit = len(self._pop_queue) - self._starting_count
+        # Maintain pipeline: spawn if LIVE demand outstrips starting workers —
+        # cancelled pops (done futures) must not trigger spawns, or a drained
+        # burst leaves a late wave of workers booting (pure CPU theft on small
+        # hosts) with no tasks to run.
+        live = sum(1 for f in self._pop_queue if not f.done())
+        deficit = live - self._starting_count
         for _ in range(min(max(deficit, 0), RayConfig.maximum_startup_concurrency - self._starting_count)):
             self._spawn_worker()
 
-    async def _pop_worker(self) -> WorkerHandle:
+    async def _pop_worker(self, token: Optional[str] = None) -> WorkerHandle:
         idle = self._idle_workers()
         if idle:
             w = idle[0]
@@ -363,9 +376,45 @@ class Nodelet:
             return w
         fut = asyncio.get_event_loop().create_future()
         self._pop_queue.append(fut)
+        if token:
+            self._lease_waiters[token] = fut
         if self._starting_count < RayConfig.maximum_startup_concurrency:
             self._spawn_worker()
-        return await fut
+        try:
+            return await fut
+        finally:
+            if token:
+                self._lease_waiters.pop(token, None)
+
+    async def rpc_cancel_lease_requests(self, conn, msg):
+        """Client gave up on outstanding lease requests (its task queue
+        drained); resolve their waits so no worker is spawned/held for them."""
+        cancelled = 0
+        for token in msg.get("tokens", ()):
+            fut = self._lease_waiters.pop(token, None)
+            if fut is not None and not fut.done():
+                fut.set_exception(_LeaseCancelled())
+                cancelled += 1
+        await self._reap_surplus_starting()
+        return {"cancelled": cancelled}
+
+    async def _reap_surplus_starting(self) -> None:
+        """With no live demand, kill workers still BOOTING: a Python worker
+        costs ~2 s of pure CPU to start, and on small hosts a wave of
+        no-longer-needed boots visibly steals the cores from whatever runs
+        next.  Booted (idle) workers are kept — they are already paid for."""
+        if any(not f.done() for f in self._pop_queue):
+            return
+        # leases queued on resources will need workers the moment capacity
+        # frees — their boots are not surplus
+        if any(not f.done() for _, _, f in self._queued_leases):
+            return
+        for w in list(self.workers.values()):
+            if w.state == "starting" and w.proc is not None:
+                self._kill_worker_proc(w)
+                # intentional reap, not a crash: no GCS worker_died report
+                await self._handle_worker_death(w, "surplus boot reaped",
+                                                report=False)
 
     async def _monitor_workers_loop(self):
         while True:
@@ -389,6 +438,10 @@ class Nodelet:
         self.workers.pop(w.worker_id, None)
         if prev_state == "starting":
             self._starting_count = max(0, self._starting_count - 1)
+            # A booting worker died (crash or surplus reap).  Live pops may
+            # have been counting on it; without a re-pump they would wait
+            # forever — nothing else spawns until the next register/return.
+            self._fulfill_pops()
         if w.lease_id is not None:
             self._release_lease(w.lease_id)
         if report and (w.is_actor or prev_state != "idle"):
@@ -523,6 +576,7 @@ class Nodelet:
                 view = self.cluster_view.get(target)
                 if view and view.get("addr"):
                     return {"type": "spillback", "node_addr": view["addr"]}
+        token = msg.get("token")
         # Local grant (or queue until resources free up).  The pump ACQUIRES on
         # behalf of the waiter before waking it, so concurrent waiters can never
         # be granted against the same capacity.
@@ -531,16 +585,28 @@ class Nodelet:
         else:
             fut = asyncio.get_event_loop().create_future()
             self._queued_leases.append((resources, bundle, fut))
+            if token:
+                self._lease_waiters[token] = fut
             try:
                 await fut  # resources are acquired by _pump_queued_leases
+            except _LeaseCancelled:
+                return {"type": "cancelled"}
             except asyncio.CancelledError:
                 if fut.done() and not fut.cancelled():
                     self._release(resources, bundle)
                 raise
+            finally:
+                if token:
+                    self._lease_waiters.pop(token, None)
         try:
-            w = await self._pop_worker()
+            w = await self._pop_worker(token)
+        except _LeaseCancelled:
+            self._release(resources, bundle)
+            self._pump_queued_leases()  # freed capacity may unblock waiters
+            return {"type": "cancelled"}
         except asyncio.CancelledError:
             self._release(resources, bundle)
+            self._pump_queued_leases()
             raise
         self._lease_seq += 1
         lease_id = self._lease_seq

@@ -1,0 +1,177 @@
+"""Core-runtime microbenchmarks.
+
+Counterpart of the reference's microbenchmark suite (reference:
+python/ray/_private/ray_perf.py; published numbers
+release/release_logs/2.9.3/microbenchmark.json, mirrored in BASELINE.md).
+Measures the same axes — task throughput (sync/async), 1:1 actor calls
+(sync/async), object put/get ops and bulk put bandwidth — so the runtime's
+pure-Python control plane is comparable line-by-line against the reference's
+C++ core.
+
+Run directly (``python -m ray_tpu._private.ray_perf``) or via
+``run_microbenchmarks()`` (bench.py embeds the results in its JSON line).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict
+
+import numpy as np
+
+# reference throughputs (BASELINE.md "Core microbenchmarks")
+BASELINE = {
+    "single_client_tasks_sync": 1007.0,
+    "single_client_tasks_async": 8444.0,
+    "actor_calls_sync_1_1": 2033.0,
+    "actor_calls_async_1_1": 8886.0,
+    "single_client_put_calls": 5545.0,
+    "single_client_get_calls": 10182.0,
+    "single_client_put_gigabytes": 20.9,
+}
+
+
+def _rate(fn: Callable[[], int], duration_s: float) -> float:
+    """Run fn repeatedly for ~duration_s; fn returns ops done per call."""
+    # warmup round
+    fn()
+    total = 0
+    t0 = time.perf_counter()
+    while True:
+        total += fn()
+        dt = time.perf_counter() - t0
+        if dt >= duration_s:
+            return total / dt
+
+
+def _settle(seconds: float = 0.5) -> None:
+    """Drain deferred work between phases (async ref releases, reply
+    callbacks, store evictions) so each metric measures its own phase, not
+    the previous one's backlog."""
+    import gc
+
+    gc.collect()
+    time.sleep(seconds)
+
+
+def run_microbenchmarks(duration_s: float = 2.0,
+                        large_put_mb: int = 64) -> Dict[str, float]:
+    import ray_tpu
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    noop_small = noop.options(num_cpus=0.01)
+
+    @ray_tpu.remote
+    class Echo:
+        def ping(self):
+            return None
+
+    results: Dict[str, float] = {}
+
+    # ------------------------------------------------ tasks, sync
+    def tasks_sync():
+        ray_tpu.get(noop_small.remote())
+        return 1
+
+    results["single_client_tasks_sync"] = _rate(tasks_sync, duration_s)
+    _settle()
+
+    # ------------------------------------------------ tasks, async batches
+    def tasks_async():
+        n = 200
+        ray_tpu.get([noop_small.remote() for _ in range(n)])
+        return n
+
+    results["single_client_tasks_async"] = _rate(tasks_async, duration_s)
+    _settle()
+
+    # ------------------------------------------------ actor calls
+    actor = Echo.options(num_cpus=0.01).remote()
+    ray_tpu.get(actor.ping.remote())
+
+    def actor_sync():
+        ray_tpu.get(actor.ping.remote())
+        return 1
+
+    results["actor_calls_sync_1_1"] = _rate(actor_sync, duration_s)
+    _settle()
+
+    def actor_async():
+        n = 200
+        ray_tpu.get([actor.ping.remote() for _ in range(n)])
+        return n
+
+    results["actor_calls_async_1_1"] = _rate(actor_async, duration_s)
+    _settle()
+
+    # ------------------------------------------------ object store ops
+    small = np.zeros(8, np.float64)
+
+    def put_calls():
+        n = 100
+        for _ in range(n):
+            ray_tpu.put(small)
+        return n
+
+    results["single_client_put_calls"] = _rate(put_calls, duration_s)
+    _settle()
+
+    ref = ray_tpu.put(np.arange(1024))
+
+    def get_calls():
+        n = 100
+        for _ in range(n):
+            ray_tpu.get(ref)
+        return n
+
+    results["single_client_get_calls"] = _rate(get_calls, duration_s)
+    _settle()
+
+    # ------------------------------------------------ bulk put bandwidth
+    # Rotation window: a few live refs, freeing the oldest as we go, so puts
+    # overlap with async releases without ever filling the store (which would
+    # measure the store-full retry sleep, not bandwidth).
+    big = np.random.default_rng(0).integers(
+        0, 255, large_put_mb * 1024 * 1024, dtype=np.uint8)
+    window: list = []
+
+    def put_gb():
+        window.append(ray_tpu.put(big))
+        if len(window) > 3:
+            window.pop(0)
+        return 1
+
+    puts_per_s = _rate(put_gb, duration_s)
+    window.clear()
+    results["single_client_put_gigabytes"] = puts_per_s * large_put_mb / 1024.0
+
+    results_vs = {
+        f"{k}_vs_baseline": round(v / BASELINE[k], 4)
+        for k, v in results.items() if k in BASELINE
+    }
+    results = {k: round(v, 2) for k, v in results.items()}
+    results.update(results_vs)
+    return results
+
+
+def main() -> None:
+    import json
+
+    import ray_tpu
+
+    started_here = not ray_tpu.is_initialized()
+    if started_here:
+        ray_tpu.init(num_cpus=4, object_store_memory=1024 * 1024**2)
+    try:
+        out = run_microbenchmarks()
+    finally:
+        if started_here:
+            ray_tpu.shutdown()
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -108,17 +108,39 @@ class Connection:
         except Exception:
             return None
 
+    # Below this, a chunk is coalesced into one write; above it, handed to
+    # the transport as-is (coalescing would memcpy a large payload just to
+    # save a syscall).
+    _COALESCE_MAX = 64 * 1024
+
     async def _send_frame(self, header: dict, inband: bytes, buffers: list):
         header_b = msgpack.packb(header)
         async with self._send_lock:
+            # Coalesce the small chunks (length prefixes, header, small
+            # inband) into ONE transport write: each StreamWriter.write is an
+            # eager socket send, and per-frame syscall count dominates small-
+            # RPC cost (measured ~0.15 ms/syscall on 1-vCPU virtio).
             w = self._writer
-            w.write(len(header_b).to_bytes(4, "little"))
-            w.write(header_b)
-            w.write(len(inband).to_bytes(8, "little"))
-            w.write(inband)
+            pending = bytearray()
+
+            def emit(chunk):
+                if len(chunk) < self._COALESCE_MAX:
+                    pending.extend(chunk)
+                else:
+                    if pending:
+                        w.write(bytes(pending))
+                        pending.clear()
+                    w.write(chunk)
+
+            emit(len(header_b).to_bytes(4, "little"))
+            emit(header_b)
+            emit(len(inband).to_bytes(8, "little"))
+            emit(inband)
             for b in buffers:
-                w.write(b.nbytes.to_bytes(8, "little"))
-                w.write(b)
+                emit(b.nbytes.to_bytes(8, "little"))
+                emit(b)
+            if pending:
+                w.write(bytes(pending))
             await w.drain()
 
     async def call(self, method: str, obj: Any = None, timeout: Optional[float] = None) -> Any:

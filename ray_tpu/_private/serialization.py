@@ -37,16 +37,33 @@ class SerializedObject:
     def total_bytes(self) -> int:
         return len(self.inband) + sum(b.nbytes for b in self.buffers)
 
-    def to_bytes(self) -> bytes:
-        """Flatten to one contiguous frame: [n_bufs][len inband][inband][len buf][buf]..."""
-        out = io.BytesIO()
-        out.write(len(self.buffers).to_bytes(4, "little"))
-        out.write(len(self.inband).to_bytes(8, "little"))
-        out.write(self.inband)
+    def total_frame_bytes(self) -> int:
+        """Size of the flattened frame (header + segments)."""
+        return 12 + len(self.inband) + sum(8 + b.nbytes for b in self.buffers)
+
+    def write_into(self, dest) -> int:
+        """Write the flattened frame into a writable buffer (e.g. a mapped
+        plasma segment) without materializing an intermediate copy; returns
+        bytes written.  Layout: [n_bufs][len inband][inband][len buf][buf]..."""
+        mv = memoryview(dest)
+        mv[0:4] = len(self.buffers).to_bytes(4, "little")
+        mv[4:12] = len(self.inband).to_bytes(8, "little")
+        off = 12
+        mv[off:off + len(self.inband)] = self.inband
+        off += len(self.inband)
         for b in self.buffers:
-            out.write(b.nbytes.to_bytes(8, "little"))
-            out.write(b)
-        return out.getvalue()
+            mv[off:off + 8] = b.nbytes.to_bytes(8, "little")
+            off += 8
+            flat = b if b.ndim == 1 and b.format == "B" else b.cast("B")
+            mv[off:off + flat.nbytes] = flat
+            off += flat.nbytes
+        return off
+
+    def to_bytes(self) -> bytes:
+        """Flatten to one contiguous frame (small objects / wire fallback)."""
+        out = bytearray(self.total_frame_bytes())
+        self.write_into(out)
+        return bytes(out)
 
     @classmethod
     def from_buffer(cls, buf) -> "SerializedObject":
