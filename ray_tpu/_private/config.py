@@ -107,6 +107,9 @@ _d("max_pending_lease_requests_per_scheduling_category", int, 10, "pipelined lea
 _d("object_store_memory_bytes", int, 2 * 1024**3, "default per-node shm store capacity")
 _d("max_direct_call_object_size", int, 100 * 1024, "objects <= this are inlined in the owner memory store")
 _d("object_store_full_delay_ms", int, 100, "retry delay when store is full")
+_d("object_transfer_inflight_bytes", int, 32 * 1024 * 1024, "max in-flight bytes per object pull")
+_d("max_lineage_entries", int, 10_000, "task specs retained per owner for object reconstruction")
+_d("object_recovery_max_attempts", int, 3, "reconstruction attempts per lost object")
 _d("fetch_chunk_bytes", int, 8 * 1024**2, "chunk size for node-to-node object transfer")
 
 # --- Fault tolerance ---

@@ -58,6 +58,8 @@ from ray_tpu._private.task_spec import (
 from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
+    ObjectLostError,
+    ObjectReconstructionFailedError,
     OwnerDiedError,
     RayActorError,
     RaySystemError,
@@ -147,6 +149,13 @@ class CoreWorker:
         self._contained: Dict[ObjectID, List[ObjectRef]] = {}
         self._owned_in_plasma: set = set()
         self._actor_handle_counts: Dict[ActorID, int] = {}
+        # Lineage: creating TaskSpec per owned plasma return, so a lost
+        # object can be rebuilt by re-running its task (reference:
+        # ObjectRecoveryManager object_recovery_manager.h:41, TaskManager
+        # lineage task_manager.h:208).  Bounded; dropped when the ref dies.
+        self._lineage: Dict[ObjectID, TaskSpec] = {}
+        self._recovery_attempts: Dict[ObjectID, int] = {}
+        self._recovery_inflight: set = set()
 
         self._owner_conns: Dict[Tuple[str, int], rpc.Connection] = {}
         self._worker_conns: Dict[Tuple[str, int], rpc.Connection] = {}
@@ -300,10 +309,76 @@ class CoreWorker:
         return value
 
     def _get_from_plasma(self, oid: ObjectID, deadline=None) -> Any:
-        mv = self.plasma.get_mapped(oid, self._remaining(deadline))
-        if mv is None:
-            raise GetTimeoutError(f"object {oid.hex()} not available within timeout")
-        return self.ctx.deserialize(SerializedObject.from_buffer(mv))
+        # Bounded local/pull rounds with a loss check between rounds: if the
+        # object is owned here, has no live location anywhere, and lineage
+        # retains its creating task, resubmit that task to rebuild it
+        # (reference: ObjectRecoveryManager::RecoverObject).
+        quick = 2.0
+        while True:
+            rem = self._remaining(deadline)
+            round_timeout = quick if rem is None else min(quick, rem)
+            mv = self.plasma.get_mapped(oid, round_timeout)
+            if mv is not None:
+                return self.ctx.deserialize(SerializedObject.from_buffer(mv))
+            # A reconstruction may have resolved through the MEMORY store
+            # instead of plasma (the re-run errored, or returned small this
+            # time): plasma polling alone would never see it.
+            if self.memory_store.known(oid):
+                ok, value, err = self.memory_store.get_if_ready(oid)
+                if err is not None:
+                    raise err
+                if ok and value is not IN_PLASMA:
+                    if isinstance(value, SerializedObject):
+                        return self.ctx.deserialize(value)
+                    return value
+            self._maybe_recover_object(oid)
+            if rem is not None and rem <= round_timeout:
+                raise GetTimeoutError(
+                    f"object {oid.hex()} not available within timeout")
+
+    def _maybe_recover_object(self, oid: ObjectID) -> None:
+        """If an owned plasma object is LOST (no live holder), re-drive its
+        creating task.  No-op for borrowed or still-transferring objects."""
+        with self._refs_lock:
+            if oid not in self._owned_in_plasma:
+                return
+            if oid in self._recovery_inflight:
+                return  # a reconstruction is already running
+            # claim the slot BEFORE the blocking locations RPC: a concurrent
+            # get must not resubmit the same (possibly side-effecting) task
+            self._recovery_inflight.add(oid)
+            spec = self._lineage.get(oid)
+        resubmitted = False
+        try:
+            try:
+                locs = self.io.run(self.gcs_conn.call(
+                    "get_object_locations", {"oids": [oid.binary()]},
+                    timeout=RayConfig.gcs_rpc_timeout_s))
+            except (ConnectionError, rpc.ConnectionLost, asyncio.TimeoutError):
+                return  # GCS unreachable/stalled: treat as transient
+            if locs.get(oid.binary()):
+                return  # a live holder exists; the pull path will fetch it
+            if spec is None:
+                # put() objects / evicted lineage are unrecoverable
+                raise ObjectLostError(oid)
+            attempts = self._recovery_attempts.get(oid, 0)
+            if attempts >= RayConfig.object_recovery_max_attempts:
+                raise ObjectReconstructionFailedError(oid)
+            self._recovery_attempts[oid] = attempts + 1
+            logger.warning(
+                "object %s lost; reconstructing by resubmitting task %s "
+                "(attempt %d)", oid.hex()[:16], spec.name, attempts + 1)
+            # A hard node affinity to the node that just died would make the
+            # reconstruction unschedulable; recovery prefers the placement
+            # but must not require it.
+            if spec.scheduling_strategy.kind == "node_affinity":
+                spec.scheduling_strategy.soft = True
+            self.io.run(self.submitter.submit(spec, []))
+            resubmitted = True
+        finally:
+            if not resubmitted:
+                with self._refs_lock:
+                    self._recovery_inflight.discard(oid)
 
     def wait(self, refs: List[ObjectRef], num_returns: int, timeout: Optional[float],
              fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
@@ -376,6 +451,8 @@ class CoreWorker:
             contained = self._contained.pop(oid, None)
             in_plasma = oid in self._owned_in_plasma
             self._owned_in_plasma.discard(oid)
+            self._lineage.pop(oid, None)
+            self._recovery_attempts.pop(oid, None)
         del contained  # dropping the ObjectRefs decrements their counts
         if in_plasma and not self._shut:
             try:
@@ -638,12 +715,22 @@ class CoreWorker:
             oid = ObjectID(item[0])
             kind = item[1]
             if kind == "val":
+                with self._refs_lock:
+                    self._recovery_inflight.discard(oid)
                 self.memory_store.put(oid, SerializedObject(item[2], [memoryview(b) for b in item[3]]))
             elif kind == "plasma":
                 with self._refs_lock:
                     self._owned_in_plasma.add(oid)
+                    self._recovery_inflight.discard(oid)
+                    # successful (re)construction resets the retry budget —
+                    # the cap is per loss, not per object lifetime
+                    self._recovery_attempts.pop(oid, None)
+                    if len(self._lineage) < RayConfig.max_lineage_entries:
+                        self._lineage[oid] = spec
                 self.memory_store.put(oid, IN_PLASMA)
             elif kind == "error":
+                with self._refs_lock:
+                    self._recovery_inflight.discard(oid)
                 err = pickle.loads(item[2])
                 if isinstance(err, RayTaskError):
                     err = err.as_instanceof_cause()
@@ -652,6 +739,8 @@ class CoreWorker:
 
     def fail_task(self, spec: TaskSpec, error: BaseException, holds: List[ObjectRef]):
         for oid in spec.return_ids():
+            with self._refs_lock:
+                self._recovery_inflight.discard(oid)
             self.memory_store.put(oid, None, error=error)
         self.release_holds(spec, holds)
 

@@ -36,6 +36,7 @@ from ray_tpu._private import rpc
 from ray_tpu._private.config import RayConfig
 from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
 from ray_tpu._private.object_store import PlasmaStore, register_store_handlers
+from ray_tpu.exceptions import ObjectStoreFullError
 
 logger = logging.getLogger(__name__)
 
@@ -263,14 +264,7 @@ class Nodelet:
                 addrs = [a for a in addrs if a != self.addr]
                 fetched = False
                 for addr in addrs:
-                    try:
-                        conn = await self._peer(addr)
-                        data = await conn.call("fetch_object", {"oid": oid.binary()},
-                                               timeout=RayConfig.gcs_rpc_timeout_s)
-                    except (ConnectionError, asyncio.TimeoutError):
-                        continue
-                    if data is not None:
-                        self.store.write_and_seal(oid, memoryview(data), is_primary=False)
+                    if await self._fetch_from(addr, oid):
                         fetched = True
                         break
                 if fetched:
@@ -292,13 +286,81 @@ class Nodelet:
             self._peer_conns[addr] = conn
         return conn
 
-    async def rpc_fetch_object(self, conn, msg):
+    async def _fetch_from(self, addr: Tuple[str, int], oid: ObjectID) -> bool:
+        """Chunked pull of one object from one holder, with bounded in-flight
+        bytes (reference: PullManager admission pull_manager.h:52, chunked
+        transfer object_manager.proto:61).  A multi-GiB object never becomes
+        one giant RPC frame; chunks land directly in the pre-allocated local
+        segment."""
+        chunk = RayConfig.fetch_chunk_bytes
+        timeout = RayConfig.gcs_rpc_timeout_s
+        try:
+            conn = await self._peer(addr)
+            meta = await conn.call("fetch_object_meta", {"oid": oid.binary()},
+                                   timeout=timeout)
+            if meta is None:
+                return False
+            size = meta["size"]
+            if size <= chunk:  # one round trip for small objects
+                data = await conn.call(
+                    "fetch_object_chunk",
+                    {"oid": oid.binary(), "off": 0, "len": size},
+                    timeout=timeout)
+                if data is None:
+                    return False
+                self.store.write_and_seal(oid, memoryview(data),
+                                          is_primary=False)
+                return True
+            self.store.create(oid, size, is_primary=False)
+            buf = self.store.write_buffer(oid)
+            sem = asyncio.Semaphore(
+                max(RayConfig.object_transfer_inflight_bytes // chunk, 1))
+            failed = False
+
+            async def fetch_chunk(off: int):
+                nonlocal failed
+                async with sem:
+                    if failed:
+                        return
+                    try:
+                        data = await conn.call(
+                            "fetch_object_chunk",
+                            {"oid": oid.binary(), "off": off,
+                             "len": min(chunk, size - off)},
+                            timeout=timeout)
+                    except (ConnectionError, asyncio.TimeoutError):
+                        failed = True
+                        return
+                    if data is None:  # holder evicted it mid-transfer
+                        failed = True
+                        return
+                    buf[off:off + len(data)] = data
+
+            await asyncio.gather(
+                *[fetch_chunk(off) for off in range(0, size, chunk)])
+            if failed:
+                self.store.abort(oid)
+                return False
+            self.store.seal(oid)
+            return True
+        except (ConnectionError, asyncio.TimeoutError, ObjectStoreFullError):
+            self.store.abort(oid)
+            return False
+
+    async def rpc_fetch_object_meta(self, conn, msg):
+        e = self.store.objects.get(ObjectID(msg["oid"]))
+        if e is None or not e.sealed:
+            return None
+        return {"size": e.size}
+
+    async def rpc_fetch_object_chunk(self, conn, msg):
         mv = self.store.read_bytes(ObjectID(msg["oid"]))
         if mv is None:
             return None
-        # bytes() copy: the RPC layer writes large buffers out-of-band, and the
-        # copy decouples the send from store eviction.
-        return bytes(mv)
+        off, ln = msg["off"], msg["len"]
+        # bytes() copy: bounded by the chunk size, and decouples the send
+        # from store eviction.
+        return bytes(mv[off:off + ln])
 
     async def rpc_free_local_objects(self, conn, msg):
         for b in msg["oids"]:

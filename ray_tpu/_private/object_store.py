@@ -206,6 +206,19 @@ class PlasmaStore:
         if self.on_sealed:
             self.on_sealed(oid, e.size)
 
+    def abort(self, oid: ObjectID) -> None:
+        """Drop an unsealed (half-written) entry, e.g. a failed chunked pull."""
+        e = self.objects.get(oid)
+        if e is not None and not e.sealed:
+            self._drop_shm(e)
+            del self.objects[oid]
+
+    def write_buffer(self, oid: ObjectID):
+        """Writable view of an unsealed entry (chunked transfer landing pad)."""
+        e = self.objects[oid]
+        assert not e.sealed, f"object {oid} already sealed"
+        return e.shm.buf
+
     def write_and_seal(self, oid: ObjectID, data: memoryview, is_primary: bool = True) -> None:
         """Server-side path used by object transfer (pull) and spill restore."""
         if self.contains(oid):

@@ -1,0 +1,114 @@
+"""Object lineage reconstruction + chunked transfer tests.
+
+Reference semantics: lost plasma primaries are rebuilt by re-running their
+creating task (src/ray/core_worker/object_recovery_manager.h:41); node-to-
+node transfer is chunked with bounded in-flight bytes
+(object_manager/push_manager.h:30, object_manager.proto:61).
+VERDICT r2 next-step #7 done-criteria.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ObjectLostError
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+def _node_ids():
+    return [n["NodeID"] for n in ray_tpu.nodes() if n["Alive"]]
+
+
+@ray_tpu.remote
+def make_blob(mb, seed, counter_file=None):
+    if counter_file:
+        with open(counter_file, "a") as f:
+            f.write("x")
+    return np.random.default_rng(seed).integers(
+        0, 255, mb * 1024 * 1024, dtype=np.uint8)
+
+
+@ray_tpu.remote
+def blob_digest(blob):
+    return hashlib.sha256(blob.tobytes()).hexdigest()
+
+
+def test_chunked_transfer_integrity(ray_start_cluster):
+    """A multi-chunk object crosses nodes in bounded chunks, intact."""
+    from ray_tpu._private.config import RayConfig
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, object_store_memory=256 * 1024**2)
+    cluster.add_node(num_cpus=2, object_store_memory=256 * 1024**2)
+    ray_tpu.init(address=cluster.address)
+    cluster.wait_for_nodes()
+    n1, n2 = _node_ids()[:2]
+
+    # 24MB > chunk size (8MB): the pull is split into >= 3 chunks
+    blob_ref = make_blob.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(n1)).remote(24, 7)
+    digest = ray_tpu.get(blob_digest.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(n2)).remote(
+            blob_ref), timeout=120)
+    expected = hashlib.sha256(np.random.default_rng(7).integers(
+        0, 255, 24 * 1024 * 1024, dtype=np.uint8).tobytes()).hexdigest()
+    assert digest == expected
+    assert 24 * 1024 * 1024 > RayConfig.fetch_chunk_bytes
+
+
+def test_lost_object_reconstructed_from_lineage(ray_start_cluster, tmp_path):
+    """Kill the node holding the only copy; ray.get still returns — the
+    owner re-runs the creating task (proven by the execution counter)."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, object_store_memory=128 * 1024**2)
+    node2 = cluster.add_node(num_cpus=2, object_store_memory=128 * 1024**2)
+    ray_tpu.init(address=cluster.address)
+    cluster.wait_for_nodes()
+    other = node2.node_id_hex
+
+    counter = str(tmp_path / "exec_count")
+    ref = make_blob.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(other)).remote(
+            1, 3, counter)
+    # materialize on the remote node only (driver never pulls a copy)
+    digest1 = ray_tpu.get(blob_digest.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(other)).remote(ref),
+        timeout=60)
+    assert os.path.getsize(counter) == 1
+
+    cluster.kill_node(node2)
+    # the only copy died with the node; get() must reconstruct
+    blob = ray_tpu.get(ref, timeout=120)
+    assert hashlib.sha256(blob.tobytes()).hexdigest() == digest1
+    assert os.path.getsize(counter) == 2, "creating task must have re-run"
+
+
+def test_lost_put_object_raises_object_lost(ray_start_cluster):
+    """put() objects have no lineage: losing the primary is a clean
+    ObjectLostError, not an infinite hang."""
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, object_store_memory=128 * 1024**2)
+    node2 = cluster.add_node(num_cpus=2, object_store_memory=128 * 1024**2)
+    ray_tpu.init(address=cluster.address)
+    cluster.wait_for_nodes()
+    other = node2.node_id_hex
+
+    # a task-produced object whose lineage we surgically drop emulates an
+    # unrecoverable loss (put() from the driver keeps its primary local,
+    # where it cannot be killed without killing the test itself)
+    ref = make_blob.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(other)).remote(1, 5)
+    ray_tpu.get(blob_digest.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(other)).remote(ref),
+        timeout=60)
+    from ray_tpu._private.worker import require_core
+
+    core = require_core()
+    with core._refs_lock:
+        core._lineage.pop(ref.oid, None)
+    cluster.kill_node(node2)
+    with pytest.raises(ObjectLostError):
+        ray_tpu.get(ref, timeout=60)
