@@ -182,7 +182,61 @@ class CoreWorker:
             self._exec_queue = asyncio.Queue()
             self._dispatch_task = self.io.spawn(self._execute_loop())
 
-        self._shut = False
+        # Task-event buffer: lifecycle events accumulate here and flush to
+        # the GCS sink periodically (reference: TaskEventBuffer
+        # core_worker/task_event_buffer.h:206 → GcsTaskManager).  Oldest
+        # events drop when the buffer overflows, never blocking the task path.
+        self._task_events: deque = deque(
+            maxlen=RayConfig.task_events_max_buffer_size)
+        self._shut = False  # must exist before the flush loop's first check
+        if RayConfig.task_events_enabled:
+            self.io.spawn(self._flush_task_events_loop())
+
+    # ------------------------------------------------------- task events
+    def emit_task_event(self, spec: TaskSpec, state: str,
+                        error: Optional[str] = None) -> None:
+        """Record one lifecycle transition; cheap append, flushed async."""
+        if not RayConfig.task_events_enabled:
+            return
+        ev = {
+            "task_id": spec.task_id.hex(),
+            "attempt": spec.attempt_number,
+            "name": spec.name,
+            "state": state,
+            "ts": time.time(),
+            "job_id": spec.job_id.hex(),
+            "type": spec.task_type.name,
+            "actor_id": (spec.actor_id or spec.actor_creation_id).hex()
+            if (spec.actor_id or spec.actor_creation_id) else None,
+            "node_id": self.node_id.hex() if self.node_id else None,
+            "worker_id": self.worker_id.hex(),
+            "pid": os.getpid(),
+        }
+        if error:
+            ev["error"] = error[:500]
+        self._task_events.append(ev)
+
+    async def _flush_task_events_loop(self):
+        interval = RayConfig.task_events_flush_interval_ms / 1000.0
+        while not self._shut:
+            await asyncio.sleep(interval)
+            await self._flush_task_events()
+
+    async def _flush_task_events(self):
+        if not self._task_events:
+            return
+        # drain via popleft: a snapshot-then-clear would drop events appended
+        # from other threads between the two calls
+        events = []
+        while True:
+            try:
+                events.append(self._task_events.popleft())
+            except IndexError:
+                break
+        try:
+            await self.gcs_conn.notify("add_task_events", {"events": events})
+        except (ConnectionError, rpc.ConnectionLost):
+            pass  # observability must never take down the task path
 
     # ====================================================== setup / teardown
     def register_with_nodelet(self):
@@ -207,6 +261,10 @@ class CoreWorker:
         if self._shut:
             return
         self._shut = True
+        try:  # last task events would otherwise be lost with the process
+            self.io.run(self._flush_task_events(), timeout=2)
+        except Exception:
+            pass
         try:
             self.io.run(self.server.stop(), timeout=5)
         except Exception:
@@ -299,7 +357,7 @@ class CoreWorker:
         except asyncio.TimeoutError:
             raise GetTimeoutError(f"object {oid.hex()} not ready within timeout") from None
         if resp.get("plasma"):
-            return self._get_from_plasma(oid, deadline)
+            return self._get_from_plasma(oid, deadline, owner_addr=owner_addr)
         if "error" in resp:
             raise pickle.loads(resp["error"])
         ser = SerializedObject(resp["value"][0], [memoryview(b) for b in resp["value"][1]])
@@ -308,11 +366,13 @@ class CoreWorker:
         self.memory_store.put(oid, ser)
         return value
 
-    def _get_from_plasma(self, oid: ObjectID, deadline=None) -> Any:
+    def _get_from_plasma(self, oid: ObjectID, deadline=None,
+                         owner_addr=None) -> Any:
         # Bounded local/pull rounds with a loss check between rounds: if the
-        # object is owned here, has no live location anywhere, and lineage
-        # retains its creating task, resubmit that task to rebuild it
-        # (reference: ObjectRecoveryManager::RecoverObject).
+        # object has no live location anywhere, its OWNER resubmits the
+        # creating task to rebuild it (reference:
+        # ObjectRecoveryManager::RecoverObject).  Borrowers trigger the
+        # owner's recovery over RPC — only the owner holds the lineage.
         quick = 2.0
         while True:
             rem = self._remaining(deadline)
@@ -331,19 +391,42 @@ class CoreWorker:
                     if isinstance(value, SerializedObject):
                         return self.ctx.deserialize(value)
                     return value
-            self._maybe_recover_object(oid)
+            if owner_addr is None or owner_addr == self.addr:
+                status = self.io.run(self._recover_object(oid))
+            else:
+                status = self._request_owner_recovery(oid, owner_addr)
+            if status == "lost":
+                raise ObjectLostError(oid)
+            if status == "exhausted":
+                raise ObjectReconstructionFailedError(oid)
             if rem is not None and rem <= round_timeout:
                 raise GetTimeoutError(
                     f"object {oid.hex()} not available within timeout")
 
-    def _maybe_recover_object(self, oid: ObjectID) -> None:
+    def _request_owner_recovery(self, oid: ObjectID, owner_addr) -> str:
+        try:
+            resp = self._owner_conn(tuple(owner_addr)).call_sync(
+                "recover_object", {"oid": oid.binary()},
+                timeout=RayConfig.gcs_rpc_timeout_s)
+            return resp.get("status", "ok")
+        except (rpc.ConnectionLost, ConnectionError, asyncio.TimeoutError):
+            return "ok"  # owner unreachable: keep polling; owner-death
+            # detection raises OwnerDiedError elsewhere
+
+    async def rpc_recover_object(self, conn, msg):
+        """A borrower noticed one of our owned objects is gone."""
+        return {"status": await self._recover_object(ObjectID(msg["oid"]))}
+
+    async def _recover_object(self, oid: ObjectID) -> str:
         """If an owned plasma object is LOST (no live holder), re-drive its
-        creating task.  No-op for borrowed or still-transferring objects."""
+        creating task.  Returns "ok" (recovering / transient / not ours),
+        "lost" (no lineage: put() object or evicted), or "exhausted" (retry
+        budget spent).  No-op for borrowed or still-transferring objects."""
         with self._refs_lock:
             if oid not in self._owned_in_plasma:
-                return
+                return "ok"
             if oid in self._recovery_inflight:
-                return  # a reconstruction is already running
+                return "ok"  # a reconstruction is already running
             # claim the slot BEFORE the blocking locations RPC: a concurrent
             # get must not resubmit the same (possibly side-effecting) task
             self._recovery_inflight.add(oid)
@@ -351,19 +434,19 @@ class CoreWorker:
         resubmitted = False
         try:
             try:
-                locs = self.io.run(self.gcs_conn.call(
+                locs = await self.gcs_conn.call(
                     "get_object_locations", {"oids": [oid.binary()]},
-                    timeout=RayConfig.gcs_rpc_timeout_s))
+                    timeout=RayConfig.gcs_rpc_timeout_s)
             except (ConnectionError, rpc.ConnectionLost, asyncio.TimeoutError):
-                return  # GCS unreachable/stalled: treat as transient
+                return "ok"  # GCS unreachable/stalled: treat as transient
             if locs.get(oid.binary()):
-                return  # a live holder exists; the pull path will fetch it
+                return "ok"  # a live holder exists; the pull path fetches it
             if spec is None:
                 # put() objects / evicted lineage are unrecoverable
-                raise ObjectLostError(oid)
+                return "lost"
             attempts = self._recovery_attempts.get(oid, 0)
             if attempts >= RayConfig.object_recovery_max_attempts:
-                raise ObjectReconstructionFailedError(oid)
+                return "exhausted"
             self._recovery_attempts[oid] = attempts + 1
             logger.warning(
                 "object %s lost; reconstructing by resubmitting task %s "
@@ -373,8 +456,18 @@ class CoreWorker:
             # but must not require it.
             if spec.scheduling_strategy.kind == "node_affinity":
                 spec.scheduling_strategy.soft = True
-            self.io.run(self.submitter.submit(spec, []))
+            # Re-pin the re-run's argument refs exactly like the original
+            # submit did — without holds, distributed GC could free an arg
+            # mid-reconstruction.
+            holds = []
+            for a in spec.args:
+                if isinstance(a, RefArg):
+                    self.ref_counter.add_submitted(a.object_id)
+                    holds.append(ObjectRef(a.object_id, a.owner_addr,
+                                           a.owner_worker_id))
+            await self.submitter.submit(spec, holds)
             resubmitted = True
+            return "ok"
         finally:
             if not resubmitted:
                 with self._refs_lock:
@@ -609,6 +702,7 @@ class CoreWorker:
             self.ref_counter.add_owned(oid, initial_local=0)
             self.memory_store.register_pending(oid)
             refs.append(ObjectRef(oid, self.addr, self.worker_id.binary()))
+        self.emit_task_event(spec, "SUBMITTED")
         self.io.spawn(self.submitter.submit(spec, holds))
         return refs
 
@@ -665,6 +759,7 @@ class CoreWorker:
             self.ref_counter.add_owned(oid, initial_local=0)
             self.memory_store.register_pending(oid)
             refs.append(ObjectRef(oid, self.addr, self.worker_id.binary()))
+        self.emit_task_event(spec, "SUBMITTED")
         self.io.spawn(self._actor_submitter(actor_id).submit(spec, holds))
         return refs
 
@@ -714,10 +809,16 @@ class CoreWorker:
         for item in returns:
             oid = ObjectID(item[0])
             kind = item[1]
+            # force=True throughout: a reconstruction re-run's outcome must
+            # replace the stale pre-loss memory-store entry (plain put is
+            # idempotent and would silently drop it)
             if kind == "val":
                 with self._refs_lock:
                     self._recovery_inflight.discard(oid)
-                self.memory_store.put(oid, SerializedObject(item[2], [memoryview(b) for b in item[3]]))
+                    self._owned_in_plasma.discard(oid)
+                self.memory_store.put(
+                    oid, SerializedObject(item[2], [memoryview(b) for b in item[3]]),
+                    force=True)
             elif kind == "plasma":
                 with self._refs_lock:
                     self._owned_in_plasma.add(oid)
@@ -727,14 +828,15 @@ class CoreWorker:
                     self._recovery_attempts.pop(oid, None)
                     if len(self._lineage) < RayConfig.max_lineage_entries:
                         self._lineage[oid] = spec
-                self.memory_store.put(oid, IN_PLASMA)
+                self.memory_store.put(oid, IN_PLASMA, force=True)
             elif kind == "error":
                 with self._refs_lock:
                     self._recovery_inflight.discard(oid)
+                    self._owned_in_plasma.discard(oid)
                 err = pickle.loads(item[2])
                 if isinstance(err, RayTaskError):
                     err = err.as_instanceof_cause()
-                self.memory_store.put(oid, None, error=err)
+                self.memory_store.put(oid, None, error=err, force=True)
         self.release_holds(spec, holds)
 
     def fail_task(self, spec: TaskSpec, error: BaseException, holds: List[ObjectRef]):
@@ -763,6 +865,7 @@ class CoreWorker:
                 await self._run_one(spec, reply_fut, release=False)
 
     async def _run_one(self, spec: TaskSpec, reply_fut: asyncio.Future, release: bool):
+        self.emit_task_event(spec, "RUNNING")
         try:
             result = await self._execute_spec(spec)
         except BaseException as e:  # never kill the loop
@@ -771,6 +874,16 @@ class CoreWorker:
         finally:
             if release and self._actor_sem is not None:
                 self._actor_sem.release()
+        if result.get("status") == "ok":
+            self.emit_task_event(spec, "FINISHED")
+        elif RayConfig.task_events_enabled:
+            err_repr = None
+            if result.get("error"):
+                try:
+                    err_repr = repr(pickle.loads(result["error"]))
+                except Exception:  # an unpicklable user error must not kill
+                    err_repr = "<error not unpicklable>"  # the dispatch loop
+            self.emit_task_event(spec, "FAILED", error=err_repr)
         if not reply_fut.done():
             reply_fut.set_result(result)
 

@@ -616,6 +616,16 @@ class GcsServer:
     async def rpc_get_all_placement_group_info(self, conn, msg):
         return self.pg_manager.list_info()
 
+    async def rpc_get_all_object_info(self, conn, msg):
+        """Object directory listing for the state API: oid -> holder nodes."""
+        out = []
+        for oid, locs in self.object_dir.items():
+            out.append({
+                "object_id": oid.hex(),
+                "locations": [NodeID(n).hex() for n in locs],
+            })
+        return out
+
     # ------------------------------------------------------------ task events
     async def rpc_add_task_events(self, conn, msg):
         self.task_events.extend(msg["events"])

@@ -40,10 +40,14 @@ class MemoryStore:
         with self._lock:
             self._entries.setdefault(oid, _Entry())
 
-    def put(self, oid: ObjectID, value: Any, error: Optional[BaseException] = None) -> None:
+    def put(self, oid: ObjectID, value: Any, error: Optional[BaseException] = None,
+            force: bool = False) -> None:
+        """force=True overwrites a ready entry — task completions use it so a
+        reconstruction re-run's outcome (new value / error) replaces the
+        stale pre-loss entry instead of being dropped by idempotency."""
         with self._lock:
             e = self._entries.setdefault(oid, _Entry())
-            if e.ready:
+            if e.ready and not force:
                 return  # idempotent (retries may double-complete)
             e.value = value
             e.error = error

@@ -296,23 +296,25 @@ class Nodelet:
         timeout = RayConfig.gcs_rpc_timeout_s
         try:
             conn = await self._peer(addr)
-            meta = await conn.call("fetch_object_meta", {"oid": oid.binary()},
-                                   timeout=timeout)
-            if meta is None:
+            # the first chunk also carries the total size, so sub-chunk
+            # objects (the common case) complete in ONE round trip
+            first = await conn.call(
+                "fetch_object_chunk",
+                {"oid": oid.binary(), "off": 0, "len": chunk},
+                timeout=timeout)
+            if first is None:
                 return False
-            size = meta["size"]
-            if size <= chunk:  # one round trip for small objects
-                data = await conn.call(
-                    "fetch_object_chunk",
-                    {"oid": oid.binary(), "off": 0, "len": size},
-                    timeout=timeout)
-                if data is None:
-                    return False
-                self.store.write_and_seal(oid, memoryview(data),
+            size = first["size"]
+            if size <= chunk:
+                self.store.write_and_seal(oid, memoryview(first["data"]),
                                           is_primary=False)
                 return True
-            self.store.create(oid, size, is_primary=False)
+            try:
+                self.store.create(oid, size, is_primary=False)
+            except FileExistsError:
+                return self.store.contains(oid)  # sealed locally mid-pull
             buf = self.store.write_buffer(oid)
+            buf[0:len(first["data"])] = first["data"]
             sem = asyncio.Semaphore(
                 max(RayConfig.object_transfer_inflight_bytes // chunk, 1))
             failed = False
@@ -323,7 +325,7 @@ class Nodelet:
                     if failed:
                         return
                     try:
-                        data = await conn.call(
+                        resp = await conn.call(
                             "fetch_object_chunk",
                             {"oid": oid.binary(), "off": off,
                              "len": min(chunk, size - off)},
@@ -331,27 +333,24 @@ class Nodelet:
                     except (ConnectionError, asyncio.TimeoutError):
                         failed = True
                         return
-                    if data is None:  # holder evicted it mid-transfer
+                    if resp is None:  # holder evicted it mid-transfer
                         failed = True
                         return
-                    buf[off:off + len(data)] = data
+                    buf[off:off + len(resp["data"])] = resp["data"]
 
             await asyncio.gather(
-                *[fetch_chunk(off) for off in range(0, size, chunk)])
+                *[fetch_chunk(off) for off in range(chunk, size, chunk)])
             if failed:
                 self.store.abort(oid)
                 return False
-            self.store.seal(oid)
+            try:
+                self.store.seal(oid)
+            except KeyError:
+                return False  # freed mid-transfer; caller re-loops
             return True
         except (ConnectionError, asyncio.TimeoutError, ObjectStoreFullError):
             self.store.abort(oid)
             return False
-
-    async def rpc_fetch_object_meta(self, conn, msg):
-        e = self.store.objects.get(ObjectID(msg["oid"]))
-        if e is None or not e.sealed:
-            return None
-        return {"size": e.size}
 
     async def rpc_fetch_object_chunk(self, conn, msg):
         mv = self.store.read_bytes(ObjectID(msg["oid"]))
@@ -360,7 +359,7 @@ class Nodelet:
         off, ln = msg["off"], msg["len"]
         # bytes() copy: bounded by the chunk size, and decouples the send
         # from store eviction.
-        return bytes(mv[off:off + ln])
+        return {"size": mv.nbytes, "data": bytes(mv[off:off + ln])}
 
     async def rpc_free_local_objects(self, conn, msg):
         for b in msg["oids"]:

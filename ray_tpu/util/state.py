@@ -1,0 +1,140 @@
+"""State API: uniform listing of cluster entities + task timeline.
+
+Counterpart of the reference's ``ray.util.state`` (reference:
+python/ray/util/state/api.py — list_nodes/list_actors/list_tasks/
+list_objects/list_placement_groups; ``ray timeline`` chrome-trace export in
+python/ray/scripts).  Everything reads through the GCS over the driver's
+existing connection; task rows are folded from the task-event stream the
+core workers flush (the GcsTaskManager equivalent).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.ids import NodeID, PlacementGroupID
+from ray_tpu._private.worker import require_core
+
+
+def _gcs_call(method: str, msg=None):
+    core = require_core()
+    return core.io.run(core.gcs_conn.call(method, msg))
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    out = []
+    for n in _gcs_call("get_all_node_info", None):
+        out.append({
+            "node_id": NodeID(n["node_id"]).hex(),
+            "state": "ALIVE" if n["alive"] else "DEAD",
+            "address": f"{n['addr'][0]}:{n['addr'][1]}",
+            "resources_total": n["total"],
+            "resources_available": n["available"],
+            "node_name": n.get("node_name", ""),
+            "labels": n.get("labels", {}),
+        })
+    return out
+
+
+def list_actors() -> List[Dict[str, Any]]:
+    out = []
+    for a in _gcs_call("get_all_actor_info", None):
+        out.append({k: (v.hex() if isinstance(v, bytes) else v)
+                    for k, v in a.items()})
+    return out
+
+
+def list_jobs() -> List[Dict[str, Any]]:
+    return [
+        {k: (v.hex() if isinstance(v, bytes) else v) for k, v in j.items()}
+        for j in _gcs_call("get_all_job_info", None)
+    ]
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    out = []
+    for i in _gcs_call("get_all_placement_group_info", None):
+        out.append({
+            **{k: v for k, v in i.items() if k not in ("pg_id", "bundle_nodes")},
+            "placement_group_id": PlacementGroupID(i["pg_id"]).hex(),
+            "bundle_nodes": [n.hex() if n else None for n in i["bundle_nodes"]],
+        })
+    return out
+
+
+def list_objects() -> List[Dict[str, Any]]:
+    """Plasma objects known to the object directory (oid -> holder nodes)."""
+    return _gcs_call("get_all_object_info", None)
+
+
+def list_tasks(limit: int = 1000, job_id: Optional[str] = None,
+               name: Optional[str] = None) -> List[Dict[str, Any]]:
+    """One row per (task, attempt), folded from lifecycle events: latest
+    state plus per-state timestamps."""
+    events = _gcs_call("get_task_events", {"limit": 100_000})
+    rows: Dict[tuple, Dict[str, Any]] = {}
+    for ev in reversed(events):  # oldest first
+        if job_id is not None and ev.get("job_id") != job_id:
+            continue
+        if name is not None and ev.get("name") != name:
+            continue
+        key = (ev["task_id"], ev.get("attempt", 0))
+        row = rows.setdefault(key, {
+            "task_id": ev["task_id"],
+            "attempt": ev.get("attempt", 0),
+            "name": ev.get("name"),
+            "type": ev.get("type"),
+            "job_id": ev.get("job_id"),
+            "actor_id": ev.get("actor_id"),
+            "state_ts": {},
+        })
+        row["state_ts"][ev["state"]] = ev["ts"]
+        row["state"] = ev["state"]
+        for k in ("node_id", "worker_id", "pid", "error"):
+            if ev.get(k) is not None:
+                row[k] = ev[k]
+    out = list(rows.values())[-limit:]
+    return out
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    """{task name: {state: count}} (reference: ray summary tasks)."""
+    summary: Dict[str, Dict[str, int]] = {}
+    for row in list_tasks(limit=100_000):
+        per = summary.setdefault(row["name"] or "?", {})
+        per[row["state"]] = per.get(row["state"], 0) + 1
+    return summary
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Chrome-tracing events (load via chrome://tracing or Perfetto) from the
+    task stream (reference: `ray timeline`).  Returns the event list; also
+    writes JSON to ``filename`` when given."""
+    trace = []
+    for row in list_tasks(limit=100_000):
+        ts = row["state_ts"]
+        start = ts.get("RUNNING")
+        if start is None:
+            continue
+        end = ts.get("FINISHED") or ts.get("FAILED") or time.time()
+        trace.append({
+            "ph": "X",
+            "cat": "task",
+            "name": row["name"],
+            "pid": (row.get("node_id") or "?")[:8],
+            "tid": (row.get("worker_id") or "?")[:8],
+            "ts": start * 1e6,
+            "dur": max((end - start) * 1e6, 1.0),
+            "args": {
+                "task_id": row["task_id"],
+                "attempt": row["attempt"],
+                "state": row["state"],
+                "type": row["type"],
+            },
+        })
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(trace, f)
+    return trace
