@@ -215,6 +215,11 @@ class CoreWorker:
         if error:
             ev["error"] = error[:500]
         self._task_events.append(ev)
+        if state in ("FINISHED", "FAILED"):
+            # Terminal events flush eagerly: a worker reused for the next task
+            # may be killed by it before the periodic tick, losing this task's
+            # whole lifecycle from the state API.
+            self.io.spawn(self._flush_task_events())
 
     async def _flush_task_events_loop(self):
         interval = RayConfig.task_events_flush_interval_ms / 1000.0
@@ -843,7 +848,11 @@ class CoreWorker:
         for oid in spec.return_ids():
             with self._refs_lock:
                 self._recovery_inflight.discard(oid)
-            self.memory_store.put(oid, None, error=error)
+            # force=True: a reconstruction re-run's failure must overwrite the
+            # stale ready IN_PLASMA entry, or blocked getters never see it.
+            self.memory_store.put(oid, None, error=error, force=True)
+        # The executing worker is gone, so it can't emit its own FAILED event.
+        self.emit_task_event(spec, "FAILED", error=repr(error))
         self.release_holds(spec, holds)
 
     def release_holds(self, spec: TaskSpec, holds: List[ObjectRef]):
@@ -1254,6 +1263,7 @@ class NormalTaskSubmitter:
                 err = pickle.loads(reply["error"])
                 if spec.retry_exceptions and spec.attempt_number < spec.max_retries:
                     spec.attempt_number += 1
+                    self.cw.emit_task_event(spec, "SUBMITTED")
                     st["pending"].append((spec, holds))
                 else:
                     self.cw.complete_task(
@@ -1265,6 +1275,7 @@ class NormalTaskSubmitter:
                 spec.attempt_number += 1
                 logger.info("retrying task %s (attempt %d) after worker failure",
                             spec.name, spec.attempt_number)
+                self.cw.emit_task_event(spec, "SUBMITTED")
                 st["pending"].append((spec, holds))
             else:
                 self.cw.fail_task(spec, WorkerCrashedError(

@@ -40,6 +40,14 @@ class GPT2Config:
     dtype: Any = jnp.bfloat16
     attention_impl: str = "flash"  # "flash" | "ring" | "reference"
     ring_axis: str = "sp"
+    # Rematerialize each block in backward (recompute activations).  Saves HBM
+    # at ~+1 forward pass of FLOPs; worth it for long-seq / large models, pure
+    # overhead for small models that fit comfortably.
+    remat: bool = True
+    # "full" recomputes everything; "dots" saves matmul outputs and recomputes
+    # only cheap elementwise ops (gelu/layernorm/softmax) — near-zero extra
+    # MXU FLOPs but longer live ranges (slower compile, more HBM).
+    remat_policy: str = "full"  # "full" | "dots"
 
     @staticmethod
     def small() -> "GPT2Config":
@@ -115,10 +123,21 @@ class GPT2LMModel(nn.Module):
         tok = nn.Embed(cfg.vocab_size, cfg.n_embd, dtype=cfg.dtype, name="wte")(input_ids)
         pe = nn.Embed(cfg.n_positions, cfg.n_embd, dtype=cfg.dtype, name="wpe")(pos)
         x = tok + pe
+        if cfg.remat_policy not in ("full", "dots"):
+            raise ValueError(f"unknown remat_policy: {cfg.remat_policy!r} "
+                             "(expected 'full' or 'dots')")
+        if cfg.remat and cfg.remat_policy == "dots":
+            block_cls = nn.remat(
+                Block,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        elif cfg.remat:
+            block_cls = nn.remat(Block)
+        else:
+            block_cls = Block
         for i in range(cfg.n_layer):
             # remat each block: trade FLOPs for HBM (activations recomputed in
             # backward) — the standard TPU memory/bandwidth trade.
-            x = nn.remat(Block)(cfg, name=f"h_{i}")(x, deterministic=deterministic)
+            x = block_cls(cfg, name=f"h_{i}")(x, deterministic=deterministic)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           name="lm_head")(x)

@@ -75,7 +75,10 @@ def list_tasks(limit: int = 1000, job_id: Optional[str] = None,
     state plus per-state timestamps."""
     events = _gcs_call("get_task_events", {"limit": 100_000})
     rows: Dict[tuple, Dict[str, Any]] = {}
-    for ev in reversed(events):  # oldest first
+    # Driver and workers flush on independent timers, so GCS arrival order is
+    # not event order — fold by emission timestamp (rank breaks exact ties).
+    _rank = {"SUBMITTED": 0, "RUNNING": 1, "FAILED": 2, "FINISHED": 2}
+    for ev in sorted(events, key=lambda e: (e["ts"], _rank.get(e["state"], 1))):
         if job_id is not None and ev.get("job_id") != job_id:
             continue
         if name is not None and ev.get("name") != name:
