@@ -29,6 +29,7 @@ import hashlib
 import logging
 import os
 import pickle
+import random
 import threading
 import time
 from collections import deque
@@ -191,6 +192,11 @@ class CoreWorker:
         self._shut = False  # must exist before the flush loop's first check
         if RayConfig.task_events_enabled:
             self.io.spawn(self._flush_task_events_loop())
+        # Synthetic return-pins awaiting caller registration (see
+        # _pin_returned_ref); swept by TTL so a caller that died before
+        # complete_task doesn't leak the pinned object forever.
+        self._return_pins: deque = deque()
+        self.io.spawn(self._sweep_return_pins_loop())
 
     # ------------------------------------------------------- task events
     def emit_task_event(self, spec: TaskSpec, state: str,
@@ -220,6 +226,18 @@ class CoreWorker:
             # may be killed by it before the periodic tick, losing this task's
             # whole lifecycle from the state API.
             self.io.spawn(self._flush_task_events())
+
+    async def _sweep_return_pins_loop(self):
+        """Expire synthetic return-pins whose caller never claimed them (the
+        caller died between our reply and its complete_task).  TTL is generous:
+        live callers release pins within one RPC round-trip."""
+        ttl = 120.0
+        while not self._shut:
+            await asyncio.sleep(ttl / 4)
+            now = time.monotonic()
+            while self._return_pins and now - self._return_pins[0][0] > ttl:
+                _, cref, token = self._return_pins.popleft()
+                self._release_return_pin(cref, token, claim=False)
 
     async def _flush_task_events_loop(self):
         interval = RayConfig.task_events_flush_interval_ms / 1000.0
@@ -429,7 +447,15 @@ class CoreWorker:
         budget spent).  No-op for borrowed or still-transferring objects."""
         with self._refs_lock:
             if oid not in self._owned_in_plasma:
-                return "ok"
+                # Not a plasma object of ours.  If we have no record of it at
+                # all (freed, or we restarted and lost the table), the borrower
+                # must not poll forever: declare it lost unless some node still
+                # holds a plasma copy (checked below via the GCS directory).
+                if (not self.ref_counter.has(oid)
+                        and not self.memory_store.known(oid)):
+                    pass  # fall through to the location check
+                else:
+                    return "ok"
             if oid in self._recovery_inflight:
                 return "ok"  # a reconstruction is already running
             # claim the slot BEFORE the blocking locations RPC: a concurrent
@@ -814,10 +840,12 @@ class CoreWorker:
         for item in returns:
             oid = ObjectID(item[0])
             kind = item[1]
+            contained_meta = ()
             # force=True throughout: a reconstruction re-run's outcome must
             # replace the stale pre-loss memory-store entry (plain put is
             # idempotent and would silently drop it)
             if kind == "val":
+                contained_meta = item[4] if len(item) > 4 else ()
                 with self._refs_lock:
                     self._recovery_inflight.discard(oid)
                     self._owned_in_plasma.discard(oid)
@@ -825,6 +853,7 @@ class CoreWorker:
                     oid, SerializedObject(item[2], [memoryview(b) for b in item[3]]),
                     force=True)
             elif kind == "plasma":
+                contained_meta = item[3] if len(item) > 3 else ()
                 with self._refs_lock:
                     self._owned_in_plasma.add(oid)
                     self._recovery_inflight.discard(oid)
@@ -842,7 +871,43 @@ class CoreWorker:
                 if isinstance(err, RayTaskError):
                     err = err.as_instanceof_cause()
                 self.memory_store.put(oid, None, error=err, force=True)
+            if contained_meta:
+                # Take our own holds on refs nested in the return value (same
+                # bookkeeping as put() with contained refs: they live until the
+                # outer object goes out of scope), then release the executor's
+                # synthetic return-pin.
+                crefs = [ObjectRef(ObjectID(b), addr, wid)
+                         for b, addr, wid in contained_meta]
+                with self._refs_lock:
+                    self._contained[oid] = crefs
+                token = spec.task_id.binary()
+                for cr in crefs:
+                    self._release_return_pin(cr, token)
         self.release_holds(spec, holds)
+
+    def _release_return_pin(self, cref: ObjectRef, token: bytes,
+                            claim: bool = True) -> None:
+        """Drop the executor's synthetic return-pin.  With claim=True (caller
+        side) our own borrow is REGISTERED first (call, not notify) on the
+        same connection, so the owner can't free the object between the two
+        messages; claim=False (executor-side TTL sweep) only drops the pin."""
+        owner_wid = cref.owner_worker_id()
+        if owner_wid is None or owner_wid == self.worker_id.binary():
+            self.ref_counter.remove_borrower(cref.oid, token)
+            return
+        async def _go():
+            try:
+                conn = await self._owner_conn_async(tuple(cref.owner_addr()))
+                if claim:
+                    await conn.call("ref_borrow", {
+                        "action": "add", "oid": cref.oid.binary(),
+                        "borrower": self.worker_id.binary()})
+                await conn.notify("ref_borrow", {
+                    "action": "remove", "oid": cref.oid.binary(),
+                    "borrower": token})
+            except (ConnectionError, OSError, rpc.ConnectionLost):
+                pass
+        self.io.spawn(_go())
 
     def fail_task(self, spec: TaskSpec, error: BaseException, holds: List[ObjectRef]):
         for oid in spec.return_ids():
@@ -1026,13 +1091,47 @@ class CoreWorker:
         returns = []
         for oid, value in zip(spec.return_ids(), outs):
             ser = self.ctx.serialize(value)
+            contained = []
+            for cref in ser.contained_refs:
+                # Pin returned refs under a synthetic borrower (the task id)
+                # until the caller registers its own holds in complete_task —
+                # otherwise the owner can free the inner object in the window
+                # between this reply and the caller's borrow registration
+                # (reference: reference_count.h borrower protocol for refs
+                # nested in task returns).
+                contained.append((cref.oid.binary(), cref.owner_addr(),
+                                  cref.owner_worker_id()))
+                self._pin_returned_ref(cref, spec.task_id.binary())
             if ser.total_bytes() > RayConfig.max_direct_call_object_size:
                 self.plasma.put_serialized(oid, ser)
-                returns.append((oid.binary(), "plasma", ser.total_bytes()))
+                returns.append((oid.binary(), "plasma", ser.total_bytes(),
+                                contained))
             else:
                 returns.append((oid.binary(), "val", ser.inband,
-                                [bytes(b) for b in ser.buffers]))
+                                [bytes(b) for b in ser.buffers], contained))
         return {"status": "ok", "returns": returns}
+
+    def _pin_returned_ref(self, cref, token: bytes) -> None:
+        owner_wid = cref.owner_worker_id()
+        # Unregistered descriptor only: holding the live ObjectRef here would
+        # keep a local ref (and thus the object) alive for the whole TTL.
+        self._return_pins.append(
+            (time.monotonic(),
+             ObjectRef(cref.oid, cref.owner_addr(), owner_wid,
+                       _register=False),
+             token))
+        if owner_wid is None or owner_wid == self.worker_id.binary():
+            self.ref_counter.add_borrower(cref.oid, token)
+            return
+        # We are only a borrower of the returned ref: register the token with
+        # the true owner while our own borrow still protects the object.
+        try:
+            self._owner_conn(tuple(cref.owner_addr())).call_sync(
+                "ref_borrow", {"action": "add", "oid": cref.oid.binary(),
+                               "borrower": token},
+                timeout=RayConfig.gcs_rpc_timeout_s)
+        except (rpc.ConnectionLost, ConnectionError, asyncio.TimeoutError):
+            pass  # owner gone: the ref is doomed regardless
 
 
 def _has_async_methods(cls) -> bool:
@@ -1165,8 +1264,17 @@ class NormalTaskSubmitter:
             info = await self.cw.gcs_conn.call("get_placement_group", {"pg_id": pg_id.binary()})
             if info is None:
                 return None
-        idx = index if index >= 0 else 0
         nodes = info["bundle_nodes"]
+        if index < 0:
+            # any-bundle: spread across the PG's nodes; the chosen nodelet
+            # resolves to whichever of its local bundles has capacity.
+            cands = sorted({n for n in nodes if n is not None})
+            if not cands:
+                return None
+            nodes = [random.choice(cands)]
+            idx = 0
+        else:
+            idx = index
         if idx >= len(nodes) or nodes[idx] is None:
             return None
         view = await self.cw.gcs_conn.call("get_cluster_view", None)
@@ -1194,8 +1302,10 @@ class NormalTaskSubmitter:
             s = spec.scheduling_strategy
             bundle = None
             if s.kind == "placement_group" and s.placement_group_id is not None:
+                # index -1 passes through: the nodelet resolves it to any local
+                # bundle with capacity (reference: bundle_index=-1 semantics).
                 bundle = (s.placement_group_id.binary(),
-                          max(s.placement_group_bundle_index, 0))
+                          s.placement_group_bundle_index)
             conn = await self._lease_target(spec)
             msg = {"resources": spec.resources,
                    "strategy": {"kind": s.kind, "node_id": s.node_id, "soft": s.soft},

@@ -1,0 +1,236 @@
+"""Columnar block model for ray_tpu.data.
+
+A *block* is the unit of data the streaming executor moves between tasks:
+a dict mapping column name -> numpy array, all with equal leading dimension.
+(Reference: python/ray/data/block.py — there a block is a pyarrow Table or
+pandas DataFrame.  Here the canonical representation is dict-of-numpy:
+numpy round-trips through the shared-memory object store zero-copy via
+pickle-5 out-of-band buffers, and it is the layout ``jax.device_put`` wants,
+so a block can go plasma -> host pinned buffer -> TPU without a row pivot.)
+
+Non-numeric python objects live in ``dtype=object`` columns, so arbitrary
+rows still fit the columnar frame.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+Block = Dict[str, np.ndarray]
+Row = Dict[str, Any]
+
+
+@dataclass
+class BlockMetadata:
+    """Sidecar stats the executor and Dataset.stats() read without fetching
+    the block itself (reference: data/block.py BlockMetadata)."""
+
+    num_rows: int
+    size_bytes: int
+    schema: Optional[Dict[str, str]] = None
+    input_files: List[str] = field(default_factory=list)
+
+
+def _column(values: List[Any]) -> np.ndarray:
+    """Build one column; fall back to object dtype for ragged/non-numeric."""
+    try:
+        arr = np.asarray(values)
+        if arr.dtype.kind in "OUSV" and not all(
+                isinstance(v, (str, bytes, np.str_, np.bytes_)) for v in values):
+            raise ValueError
+        # np.asarray silently collapses mixed-length sequences only on
+        # dtype=object; anything else is a clean column.
+        return arr
+    except (ValueError, TypeError):
+        arr = np.empty(len(values), dtype=object)
+        for i, v in enumerate(values):
+            arr[i] = v
+        return arr
+
+
+class BlockAccessor:
+    """Stateless helpers over the dict-of-numpy block format."""
+
+    # ------------------------------------------------------------ construct
+    @staticmethod
+    def from_rows(rows: Sequence[Row]) -> Block:
+        if not rows:
+            return {}
+        cols: Dict[str, List[Any]] = {}
+        for i, r in enumerate(rows):
+            if not isinstance(r, dict):
+                r = {"item": r}
+            for k in r:
+                if k not in cols:
+                    # column appearing late: backfill
+                    cols[k] = [None] * i
+            for k, vals in cols.items():
+                vals.append(r.get(k) if isinstance(r, dict) else None)
+        return {k: _column(v) for k, v in cols.items()}
+
+    @staticmethod
+    def from_pandas(df) -> Block:
+        return {c: df[c].to_numpy() for c in df.columns}
+
+    @staticmethod
+    def to_pandas(block: Block):
+        import pandas as pd
+
+        return pd.DataFrame({k: list(v) if v.ndim > 1 else v
+                             for k, v in block.items()})
+
+    @staticmethod
+    def from_arrow(table) -> Block:
+        out = {}
+        for name in table.column_names:
+            col = table.column(name)
+            try:
+                out[name] = col.to_numpy(zero_copy_only=False)
+            except Exception:
+                out[name] = _column(col.to_pylist())
+        return out
+
+    @staticmethod
+    def to_arrow(block: Block):
+        import pyarrow as pa
+
+        return pa.table({k: (list(v) if v.ndim > 1 or v.dtype.kind == "O"
+                             else v)
+                         for k, v in block.items()})
+
+    # ------------------------------------------------------------ inspect
+    @staticmethod
+    def num_rows(block: Block) -> int:
+        if not block:
+            return 0
+        return len(next(iter(block.values())))
+
+    @staticmethod
+    def size_bytes(block: Block) -> int:
+        total = 0
+        for v in block.values():
+            if v.dtype.kind == "O":
+                # rough: object columns priced per-element via repr length
+                total += sum(64 + getattr(x, "nbytes", len(repr(x)))
+                             for x in v[:100]) * max(1, len(v) // max(1, min(len(v), 100)))
+            else:
+                total += v.nbytes
+        return total
+
+    @staticmethod
+    def schema(block: Block) -> Dict[str, str]:
+        out = {}
+        for k, v in block.items():
+            t = "object" if v.dtype.kind == "O" else str(v.dtype)
+            if v.ndim > 1:
+                t += str(list(v.shape[1:]))
+            out[k] = t
+        return out
+
+    @staticmethod
+    def metadata(block: Block,
+                 input_files: Optional[List[str]] = None) -> BlockMetadata:
+        return BlockMetadata(
+            num_rows=BlockAccessor.num_rows(block),
+            size_bytes=BlockAccessor.size_bytes(block),
+            schema=BlockAccessor.schema(block),
+            input_files=input_files or [])
+
+    # ------------------------------------------------------------ transform
+    @staticmethod
+    def slice(block: Block, start: int, end: int) -> Block:
+        return {k: v[start:end] for k, v in block.items()}
+
+    @staticmethod
+    def concat(blocks: Sequence[Block]) -> Block:
+        blocks = [b for b in blocks if BlockAccessor.num_rows(b) > 0]
+        if not blocks:
+            return {}
+        if len(blocks) == 1:
+            return blocks[0]
+        keys = list(blocks[0].keys())
+        out = {}
+        for k in keys:
+            cols = [b[k] for b in blocks]
+            if any(c.dtype.kind == "O" for c in cols):
+                merged = np.empty(sum(len(c) for c in cols), dtype=object)
+                i = 0
+                for c in cols:
+                    merged[i:i + len(c)] = c
+                    i += len(c)
+                out[k] = merged
+            else:
+                out[k] = np.concatenate(cols, axis=0)
+        return out
+
+    @staticmethod
+    def iter_rows(block: Block) -> Iterator[Row]:
+        keys = list(block.keys())
+        for i in range(BlockAccessor.num_rows(block)):
+            yield {k: block[k][i] for k in keys}
+
+    @staticmethod
+    def take_idx(block: Block, idx: np.ndarray) -> Block:
+        return {k: v[idx] for k, v in block.items()}
+
+    @staticmethod
+    def select(block: Block, cols: Sequence[str]) -> Block:
+        missing = [c for c in cols if c not in block]
+        if missing:
+            raise KeyError(f"columns not in block: {missing}; "
+                           f"available: {list(block)}")
+        return {c: block[c] for c in cols}
+
+    @staticmethod
+    def drop(block: Block, cols: Sequence[str]) -> Block:
+        return {k: v for k, v in block.items() if k not in cols}
+
+    @staticmethod
+    def sort_key_array(block: Block, key: str, descending: bool = False):
+        col = block[key]
+        order = np.argsort(col, kind="stable")
+        if descending:
+            order = order[::-1]
+        return order
+
+    @staticmethod
+    def normalize(batch: Any, what: str = "map_batches") -> Block:
+        """Coerce a user function's return value back into a block."""
+        if batch is None:
+            return {}
+        if isinstance(batch, dict):
+            return {k: v if isinstance(v, np.ndarray) else _column(list(v))
+                    for k, v in batch.items()}
+        try:
+            import pandas as pd
+
+            if isinstance(batch, pd.DataFrame):
+                return BlockAccessor.from_pandas(batch)
+        except ImportError:
+            pass
+        try:
+            import pyarrow as pa
+
+            if isinstance(batch, pa.Table):
+                return BlockAccessor.from_arrow(batch)
+        except ImportError:
+            pass
+        if isinstance(batch, list):
+            return BlockAccessor.from_rows(batch)
+        raise TypeError(
+            f"{what} must return dict[str, np.ndarray], pandas.DataFrame, "
+            f"pyarrow.Table, or list[dict]; got {type(batch)}")
+
+
+def format_batch(block: Block, batch_format: Optional[str]):
+    """Present a block to user code in the requested format."""
+    if batch_format in (None, "numpy", "native", "default"):
+        return block
+    if batch_format == "pandas":
+        return BlockAccessor.to_pandas(block)
+    if batch_format == "pyarrow":
+        return BlockAccessor.to_arrow(block)
+    raise ValueError(f"unknown batch_format: {batch_format!r}")

@@ -191,3 +191,60 @@ class TestClusterInfo:
 
         task_id, worker_id = ray_tpu.get(ctx_info.remote(), timeout=60)
         assert task_id and worker_id
+
+
+class TestReturnedRefs:
+    def test_ref_returned_by_actor_survives_owner_release(
+            self, ray_start_regular):
+        """An ObjectRef nested in an actor's RETURN value must stay alive
+        after the actor drops its own handle: the executor pins it under a
+        synthetic borrower until the caller registers its holds (reference:
+        reference_count.h borrower protocol for refs in task returns).
+        Regression: the owner used to free the object in that window and the
+        borrower's get() hung forever."""
+        import gc
+        import time
+
+        @ray_tpu.remote
+        class Maker:
+            def make(self):
+                ref = ray_tpu.put({"payload": 123})
+                return ref  # only copy: dropped when this frame exits
+
+            def collect(self):
+                gc.collect()
+                return True
+
+        m = Maker.remote()
+        inner = ray_tpu.get(m.make.remote(), timeout=30)
+        assert ray_tpu.get(m.collect.remote(), timeout=30)
+        time.sleep(0.5)  # let any stray free propagate
+        assert ray_tpu.get(inner, timeout=30) == {"payload": 123}
+
+    def test_ref_created_by_task_returned_through_actor(
+            self, ray_start_regular):
+        """Same protocol, with the inner object produced by a task the actor
+        submitted (the streaming-Data coordinator pattern)."""
+        import gc
+        import time
+
+        @ray_tpu.remote
+        def produce():
+            return list(range(100))
+
+        @ray_tpu.remote
+        class Coord:
+            def run(self):
+                ref = produce.remote()
+                ray_tpu.wait([ref], num_returns=1, timeout=30)
+                return ref
+
+            def collect(self):
+                gc.collect()
+                return True
+
+        c = Coord.remote()
+        inner = ray_tpu.get(c.run.remote(), timeout=30)
+        assert ray_tpu.get(c.collect.remote(), timeout=30)
+        time.sleep(0.5)
+        assert ray_tpu.get(inner, timeout=30) == list(range(100))
