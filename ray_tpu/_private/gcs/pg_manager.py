@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -107,11 +108,12 @@ class PlacementGroupManager:
         if pg is None or pg.state != "CREATED":
             return None
         if index < 0:
-            # any bundle with capacity; callers resolve -1 to a concrete node
-            for nid in pg.bundle_nodes:
-                if nid is not None:
-                    return nid
-            return None
+            # Any-bundle request: pick among the PG's nodes at random — the
+            # chosen nodelet resolves to a local bundle with capacity, and the
+            # actor scheduling loop re-picks on each retry, so a busy node
+            # doesn't pin the request forever (reference: bundle_index=-1).
+            cands = [nid for nid in pg.bundle_nodes if nid is not None]
+            return random.choice(cands) if cands else None
         if index >= len(pg.bundle_nodes):
             return None
         return pg.bundle_nodes[index]

@@ -420,7 +420,11 @@ class GcsServer:
         import pickle
 
         spec: TaskSpec = pickle.loads(info.spec)
-        deadline = time.monotonic() + 60.0
+        # No scheduling deadline: an actor queued behind busy resources (or an
+        # infeasible one awaiting a node that may yet join) stays PENDING
+        # indefinitely, surfaced via the state API (reference: GcsActorManager
+        # keeps pending actors queued until resources appear).
+        delay = 0.2
         while True:
             # Placement-group bundles pin the actor to the bundle's node.
             target = None
@@ -476,12 +480,10 @@ class GcsServer:
                             fut.set_result(True)
                     info.pending_waiters.clear()
                     return
-            if time.monotonic() > deadline:
-                info.state = "DEAD"
-                info.death_cause = f"could not schedule actor: no feasible node for {spec.resources}"
-                await self._publish_actor(info)
-                return
-            await asyncio.sleep(0.2)
+            if info.state not in ("PENDING_CREATION", "RESTARTING"):
+                return  # killed / job-reclaimed while we were waiting
+            await asyncio.sleep(delay)
+            delay = min(delay * 1.5, 2.0)
 
     async def _publish_actor(self, info: ActorInfo):
         await self.publish("actor", info.public_info())

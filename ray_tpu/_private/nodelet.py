@@ -554,6 +554,33 @@ class Nodelet:
     def _feasible_local(self, resources: Dict[str, float]) -> bool:
         return all(self.resources_total.get(k, 0.0) >= v for k, v in resources.items() if v > 0)
 
+    def _resolve_bundle(self, bundle, resources: Dict[str, float]):
+        """Resolve a lease's bundle key; index -1 means "any bundle of this
+        placement group with capacity" (reference: bundle_index=-1 semantics in
+        bundle_spec.h — the reference picks any bundle that fits).  Returns
+        (concrete_bundle, error_reason)."""
+        if bundle is None:
+            return None, None
+        bundle = (bundle[0], bundle[1])
+        if bundle[1] >= 0:
+            if bundle not in self.bundles:
+                return None, "unknown placement bundle"
+            return bundle, None
+        cands = sorted(k for k in self.bundles if k[0] == bundle[0])
+        if not cands:
+            return None, "no bundle of this placement group on this node"
+        for k in cands:
+            if self._fits_local(resources, k):
+                return k, None
+        # All busy now — but only queue on a bundle whose TOTAL can ever fit;
+        # a request exceeding every bundle's capacity must error, not hang.
+        for k in cands:
+            total = self.bundles[k].resources
+            if all(total.get(rk, 0.0) >= v
+                   for rk, v in resources.items() if v > 0):
+                return k, None
+        return None, "request exceeds every bundle's total resources"
+
     def _acquire(self, resources: Dict[str, float], bundle) -> None:
         if bundle is not None:
             b = self.bundles[tuple(bundle)]
@@ -590,23 +617,29 @@ class Nodelet:
             return None
         kind = strategy.get("kind", "default")
         ready = [f for f in feasible if f[2]]
+        # Score by the REQUESTED resource shape, not CPU alone: a TPU-saturated
+        # node must not look idle to a TPU task just because its CPUs are free
+        # (reference: LeastResourceScorer scores the demanded resources,
+        # scorer.h:41).
+        req_keys = [k for k, v in resources.items() if v > 0] or ["CPU"]
         if kind == "spread":
-            # Prefer ready nodes, least-loaded (most available CPU) first,
-            # breaking ties away from this node.
+            # Prefer ready nodes, most headroom for this request first.
             pool = ready or feasible
             def load_key(f):
                 nid, view, _ = f
                 avail = view.get("available", {}) if nid != my_id else self.resources_available
-                return -(avail.get("CPU", 0.0))
+                return -min(avail.get(k, 0.0) / max(resources.get(k, 1.0), 1e-9)
+                            for k in req_keys)
             pool.sort(key=load_key)
             return pool[0][0]
         # hybrid default: prefer local while it has capacity, else first ready
         # node, else queue locally (return my_id with no capacity -> queued).
         if self._fits_local(resources, None) or not ready:
             return my_id
-        local_util = 1.0 - (
-            self.resources_available.get("CPU", 0.0)
-            / max(self.resources_total.get("CPU", 1.0), 1e-9))
+        local_util = max(
+            1.0 - (self.resources_available.get(k, 0.0)
+                   / max(self.resources_total.get(k, 1e-9), 1e-9))
+            for k in req_keys)
         if local_util < RayConfig.scheduler_spread_threshold and self._feasible_local(resources):
             return my_id
         return ready[0][0]
@@ -624,9 +657,9 @@ class Nodelet:
         bundle = msg.get("bundle")
         spillback_count = msg.get("spillback_count", 0)
         if bundle is not None:
-            bundle = (bundle[0], bundle[1])
-            if tuple(bundle) not in self.bundles:
-                return {"type": "infeasible", "reason": "unknown placement bundle"}
+            bundle, err = self._resolve_bundle(bundle, resources)
+            if err is not None:
+                return {"type": "infeasible", "reason": err}
         elif strategy.get("kind") not in ("node_affinity",) and spillback_count < 2:
             target = self._pick_node(resources, strategy)
             if target is None:
@@ -714,9 +747,9 @@ class Nodelet:
         spec = pickle.loads(msg["spec"])
         bundle = msg.get("bundle")
         if bundle is not None:
-            bundle = (bundle[0], bundle[1])
-            if bundle not in self.bundles:
-                return {"ok": False, "reason": "unknown bundle"}
+            bundle, err = self._resolve_bundle(bundle, spec.resources)
+            if err is not None:
+                return {"ok": False, "reason": err}
         if self._fits_local(spec.resources, bundle):
             self._acquire(spec.resources, bundle)
         else:

@@ -151,3 +151,34 @@ class TestActors:
         m = Multi.remote()
         r1, r2 = m.pair.remote()
         assert ray_tpu.get([r1, r2], timeout=60) == ["a", "b"]
+
+
+class TestPendingActors:
+    def test_actor_queued_behind_busy_resources_schedules_later(
+            self, ray_start_isolated):
+        """An actor that cannot be placed NOW stays PENDING (no scheduling
+        deadline) and becomes ALIVE once resources free up (reference:
+        GcsActorManager keeps pending actors queued indefinitely)."""
+        import time
+
+        @ray_tpu.remote(num_cpus=4)
+        class Hog:
+            def ping(self):
+                return "ok"
+
+        @ray_tpu.remote(num_cpus=4)
+        class Late:
+            def ping(self):
+                return "late"
+
+        hog = Hog.remote()
+        assert ray_tpu.get(hog.ping.remote(), timeout=60) == "ok"
+        late = Late.remote()
+        time.sleep(3)  # old behavior: a fixed deadline would DEAD it; new
+        # behavior: still pending, not dead
+        from ray_tpu.util import state
+
+        infos = {a["class_name"]: a for a in state.list_actors()}
+        assert infos["Late"]["state"] not in ("DEAD",), infos["Late"]
+        ray_tpu.kill(hog)
+        assert ray_tpu.get(late.ping.remote(), timeout=60) == "late"

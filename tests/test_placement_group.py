@@ -156,3 +156,58 @@ class TestTpuGang:
         pg = placement_group([{"TPU": 4}] * 2, strategy="STRICT_SPREAD")
         assert pg.ready(timeout=30)
         assert len(set(pg.bundle_node_ids())) == 2
+
+
+class TestAnyBundle:
+    def test_bundle_index_minus_one_uses_free_bundle(self, ray_start_regular):
+        """bundle_index=-1 means "any bundle with capacity" — the second actor
+        must land in the second bundle, not queue behind the first (reference:
+        bundle_spec.h -1 semantics; regression for the old resolve-to-0)."""
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+        assert pg.ready(timeout=30)
+        strat = PlacementGroupSchedulingStrategy(pg)  # index defaults to -1
+        a = WhereAmI.options(scheduling_strategy=strat).remote()
+        b = WhereAmI.options(scheduling_strategy=strat).remote()
+        # Both resolve within the timeout only if they occupy distinct bundles.
+        assert ray_tpu.get([a.node.remote(), b.node.remote()], timeout=60)
+        ray_tpu.kill(a)
+        ray_tpu.kill(b)
+        remove_placement_group(pg)
+
+
+class TestResourceAwareScoring:
+    def test_accelerator_task_spills_off_saturated_node(self, ray_start_cluster):
+        """Hybrid scheduling must score the REQUESTED resource, not CPU: a
+        node whose accelerator is taken but whose CPUs are free must spill an
+        accelerator task to a node with a free accelerator (reference:
+        LeastResourceScorer, scorer.h:41).  Regression for CPU-only scoring,
+        which queued the task locally forever."""
+        from ray_tpu.util import NodeAffinitySchedulingStrategy
+
+        cluster = ray_start_cluster
+        cluster.add_node(num_cpus=2, resources={"ACC": 1})
+        cluster.add_node(num_cpus=2, resources={"ACC": 1})
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes()
+
+        local = cluster.head_node.node_id_hex  # the driver's local nodelet
+
+        @ray_tpu.remote(resources={"ACC": 1})
+        class Hog:
+            def node(self):
+                from ray_tpu.runtime_context import get_runtime_context
+
+                return get_runtime_context().get_node_id()
+
+        hog = Hog.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(local)).remote()
+        assert ray_tpu.get(hog.node.remote(), timeout=60) == local
+
+        @ray_tpu.remote(resources={"ACC": 1})
+        def acc_task():
+            from ray_tpu.runtime_context import get_runtime_context
+
+            return get_runtime_context().get_node_id()
+
+        where = ray_tpu.get(acc_task.remote(), timeout=60)
+        assert where != local, "ACC task ran on the saturated node"
