@@ -131,13 +131,13 @@ class CoreWorker:
                 # Dropped in the window before the callback was attached (an
                 # already-closed connection never re-fires it).
                 self.shutdown_event.set()
+        self._gcs_addr = gcs_addr
+        self._gcs_handlers = {"publish": self._on_publish, **handlers}
         self.gcs_conn: rpc.Connection = self.io.run(
-            rpc.connect(
-                *gcs_addr,
-                handlers={"publish": self._on_publish, **handlers},
-                name="worker->gcs",
-            )
+            rpc.connect(*gcs_addr, handlers=self._gcs_handlers,
+                        name="worker->gcs")
         )
+        self.gcs_conn._on_close = self._on_gcs_lost
         self.plasma = PlasmaClient(self.io, self.nodelet_conn)
         self.io.run(self.gcs_conn.call("client_hello",
                                        {"worker_id": self.worker_id.binary()}))
@@ -316,6 +316,54 @@ class CoreWorker:
     def subscribe(self, channel: str, cb) -> None:
         self._subscriptions.setdefault(channel, []).append(cb)
         self.io.run(self.gcs_conn.call("subscribe", {"channel": channel}))
+
+    # ------------------------------------------------- GCS reconnect (FT)
+    def _on_gcs_lost(self, conn) -> None:
+        if getattr(self, "_shut", False) or getattr(self, "_gcs_reconnecting", False):
+            return
+        self._gcs_reconnecting = True
+        logger.warning("lost the GCS connection; reconnecting")
+        self.io.spawn(self._gcs_reconnect_loop())
+
+    async def _gcs_reconnect_loop(self) -> None:
+        """Outlive a GCS restart (reference: workers survive GCS failover when
+        FT is enabled).  Calls issued during the outage fail with
+        ConnectionLost; retry loops around the runtime already tolerate that."""
+        deadline = time.monotonic() + RayConfig.gcs_reconnect_timeout_s
+        delay = 0.2
+        try:
+            while not self._shut:
+                await asyncio.sleep(delay)
+                try:
+                    conn = await rpc.connect(*self._gcs_addr,
+                                             handlers=self._gcs_handlers,
+                                             name="worker->gcs")
+                    await conn.call("client_hello",
+                                    {"worker_id": self.worker_id.binary()})
+                    for channel in self._subscriptions:
+                        await conn.call("subscribe", {"channel": channel})
+                    self.gcs_conn = conn
+                    # attach last so a failed half-setup can't spawn a second
+                    # loop; re-fire manually if it dropped in the window
+                    conn._on_close = self._on_gcs_lost
+                    logger.info("reconnected to the GCS")
+                    if conn.closed:
+                        self._gcs_reconnecting = False
+                        self._on_gcs_lost(conn)
+                    return
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    # Never give up permanently: a driver wedged on a dead
+                    # connection after the GCS comes BACK would fail every
+                    # control-plane call forever (the nodelet exits instead;
+                    # a user-facing driver must not).
+                    if time.monotonic() > deadline:
+                        logger.warning(
+                            "GCS still unreachable after %.0fs; retrying "
+                            "in the background", RayConfig.gcs_reconnect_timeout_s)
+                        deadline = float("inf")
+                    delay = min(delay * 1.5, 5.0)
+        finally:
+            self._gcs_reconnecting = False
 
     # ======================================================== object: put/get
     def _next_put_id(self) -> ObjectID:
@@ -1019,16 +1067,34 @@ class CoreWorker:
             self.executor_pool, self._invoke_normal_sync, spec)
 
     def _invoke_normal_sync(self, spec: TaskSpec) -> dict:
+        from ray_tpu import runtime_env as renv
+
         try:
-            fn = self._load_function(spec)
+            # Env applied around BOTH function load and invocation: cloudpickle
+            # resolves by-reference functions at load time, so working_dir /
+            # py_modules must already be on sys.path there.
+            with renv.applied(spec.runtime_env):
+                try:
+                    fn = self._load_function(spec)
+                except BaseException as e:
+                    return {"status": "error", "error": pickle.dumps(
+                        RayTaskError.from_exception(spec.name, e))}
+                return self._invoke_sync(spec, fn)
+        except BaseException as e:  # env setup itself failed
+            return {"status": "error",
+                    "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
+
+    def _create_actor_sync(self, spec: TaskSpec) -> dict:
+        try:
+            from ray_tpu import runtime_env as renv
+
+            # Dedicated worker: the env holds for the actor's whole life.
+            renv.apply_permanent(spec.runtime_env)
+            cls = self._load_function(spec)
+            args, kwargs = self._resolve_args(spec)
         except BaseException as e:
             return {"status": "error",
                     "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
-        return self._invoke_sync(spec, fn)
-
-    def _create_actor_sync(self, spec: TaskSpec) -> dict:
-        cls = self._load_function(spec)
-        args, kwargs = self._resolve_args(spec)
         self.task_ctx.task_id = spec.task_id
         self.task_ctx.job_id = spec.job_id
         self.task_ctx.actor_id = spec.actor_creation_id
@@ -1056,6 +1122,9 @@ class CoreWorker:
         if self.job_id.int_value() == 0:
             self.job_id = spec.job_id
         try:
+            # Runtime env is already active here: applied by _invoke_normal_sync
+            # (leased task workers, save/restore) or permanently at actor
+            # creation (dedicated workers).
             args, kwargs = self._resolve_args(spec)
             out = fn(*args, **kwargs)
             return self._pack_returns(spec, out)

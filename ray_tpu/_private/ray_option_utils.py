@@ -55,6 +55,11 @@ def merge_options(defaults: Dict[str, Any], *layers: Optional[Dict[str, Any]]) -
             if k not in defaults:
                 raise ValueError(f"unknown option {k!r}; valid: {sorted(defaults)}")
             out[k] = v
+    if out.get("runtime_env") is not None:
+        from ray_tpu import runtime_env as renv
+
+        # Reject unknown/unsupported fields at SUBMISSION, not on the worker.
+        out["runtime_env"] = renv.validate(out["runtime_env"])
     return out
 
 

@@ -1,0 +1,144 @@
+"""Per-task/actor runtime environments.
+
+Counterpart of the reference's runtime-env system (reference:
+python/ray/runtime_env/runtime_env.py:152 RuntimeEnv and the plugin set in
+python/ray/_private/runtime_env/{working_dir,py_modules}.py), scoped to what a
+TPU pod actually needs: ``env_vars`` (config/flags for jax, XLA, HF caches),
+``working_dir`` (run user code from a project directory) and ``py_modules``
+(extra import roots).  conda/pip/container plugins are deliberately out of
+scope — TPU pods run a single baked image, so new interpreters per task are
+an anti-pattern here; the validation rejects those keys loudly rather than
+silently ignoring them.
+
+Mechanics: the environment travels inside the TaskSpec.  Workers are leased
+per scheduling class, which already includes the runtime env
+(task_spec.py scheduling_class), so one worker never interleaves two
+environments mid-lease; the executing worker applies the env around task
+execution (save/restore for leased task workers, permanent for dedicated
+actor workers).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+from typing import Dict, List, Optional
+
+_SUPPORTED = ("env_vars", "working_dir", "py_modules")
+_UNSUPPORTED = ("conda", "pip", "uv", "container", "image_uri", "java_jars")
+
+
+class RuntimeEnv(dict):
+    """Validated runtime-environment spec (dict-compatible, like the
+    reference's RuntimeEnv)."""
+
+    def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
+                 working_dir: Optional[str] = None,
+                 py_modules: Optional[List[str]] = None, **kwargs):
+        super().__init__()
+        for k in kwargs:
+            if k in _UNSUPPORTED:
+                raise ValueError(
+                    f"runtime_env field {k!r} is not supported on this "
+                    f"runtime (single-image TPU pods); supported: "
+                    f"{_SUPPORTED}")
+            raise ValueError(f"unknown runtime_env field {k!r}; "
+                             f"supported: {_SUPPORTED}")
+        if env_vars is not None:
+            validate_env_vars(env_vars)
+            self["env_vars"] = dict(env_vars)
+        if working_dir is not None:
+            validate_working_dir(working_dir)
+            self["working_dir"] = working_dir
+        if py_modules is not None:
+            if not isinstance(py_modules, (list, tuple)):
+                raise TypeError("py_modules must be a list of paths")
+            self["py_modules"] = [str(p) for p in py_modules]
+
+
+def validate_env_vars(env_vars) -> None:
+    if not isinstance(env_vars, dict) or not all(
+            isinstance(k, str) and isinstance(v, str)
+            for k, v in env_vars.items()):
+        raise TypeError("env_vars must be a Dict[str, str]")
+
+
+def validate_working_dir(working_dir) -> None:
+    if not isinstance(working_dir, str):
+        raise TypeError("working_dir must be a local directory path")
+
+
+def validate(runtime_env: Optional[dict]) -> Optional[dict]:
+    """Normalize + validate a runtime_env option value at submission time."""
+    if runtime_env is None:
+        return None
+    if isinstance(runtime_env, RuntimeEnv):
+        return dict(runtime_env)
+    if not isinstance(runtime_env, dict):
+        raise TypeError("runtime_env must be a dict or RuntimeEnv")
+    return dict(RuntimeEnv(**runtime_env))
+
+
+@contextlib.contextmanager
+def applied(runtime_env: Optional[dict]):
+    """Apply a runtime env around task execution; restores previous state on
+    exit so a leased worker returned to the pool is clean.  Actor-creation
+    callers enter this WITHOUT exiting (dedicated worker, env for life)."""
+    if not runtime_env:
+        yield
+        return
+    saved_env: Dict[str, Optional[str]] = {}
+    saved_cwd = None
+    added_paths: List[str] = []
+    try:
+        for k, v in (runtime_env.get("env_vars") or {}).items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        wd = runtime_env.get("working_dir")
+        if wd:
+            if not os.path.isdir(wd):
+                raise FileNotFoundError(
+                    f"runtime_env working_dir {wd!r} does not exist on this "
+                    f"node (shared filesystem expected)")
+            saved_cwd = os.getcwd()
+            os.chdir(wd)
+            sys.path.insert(0, wd)
+            added_paths.append(wd)
+        for p in runtime_env.get("py_modules") or []:
+            sys.path.insert(0, p)
+            added_paths.append(p)
+        yield
+    finally:
+        for p in added_paths:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
+        if saved_cwd is not None:
+            try:
+                os.chdir(saved_cwd)
+            except OSError:
+                pass
+        for k, old in saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
+
+def apply_permanent(runtime_env: Optional[dict]) -> None:
+    """Actor-lifetime application (dedicated worker): no restore."""
+    if not runtime_env:
+        return
+    for k, v in (runtime_env.get("env_vars") or {}).items():
+        os.environ[k] = v
+    wd = runtime_env.get("working_dir")
+    if wd:
+        if not os.path.isdir(wd):
+            raise FileNotFoundError(
+                f"runtime_env working_dir {wd!r} does not exist on this node")
+        os.chdir(wd)
+        sys.path.insert(0, wd)
+    for p in runtime_env.get("py_modules") or []:
+        sys.path.insert(0, p)

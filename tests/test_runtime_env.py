@@ -1,0 +1,74 @@
+"""Runtime environments: env_vars / working_dir / py_modules propagation
+(reference semantics: python/ray/runtime_env/runtime_env.py:152 and the
+working_dir/py_modules plugins; conda/pip deliberately unsupported here)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime_env import RuntimeEnv
+
+
+@pytest.fixture
+def cluster():
+    from conftest import ensure_shared_runtime
+
+    yield ensure_shared_runtime()
+
+
+def test_env_vars_in_task(cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_TEST_FLAG": "42"}})
+    def read_flag():
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    assert ray_tpu.get(read_flag.remote(), timeout=60) == "42"
+
+    @ray_tpu.remote
+    def read_plain():
+        return os.environ.get("RTPU_TEST_FLAG")
+
+    # a worker without the env must not see the variable (restore discipline)
+    assert ray_tpu.get(read_plain.remote(), timeout=60) is None
+
+
+def test_env_vars_for_actor_lifetime(cluster):
+    @ray_tpu.remote(runtime_env={"env_vars": {"RTPU_ACTOR_FLAG": "on"}})
+    class Holder:
+        def read(self):
+            return os.environ.get("RTPU_ACTOR_FLAG")
+
+    h = Holder.remote()
+    assert ray_tpu.get(h.read.remote(), timeout=60) == "on"
+    assert ray_tpu.get(h.read.remote(), timeout=60) == "on"
+    ray_tpu.kill(h)
+
+
+def test_working_dir_and_py_modules(tmp_path, cluster):
+    mod_dir = tmp_path / "proj"
+    mod_dir.mkdir()
+    (mod_dir / "rtpu_proj_mod.py").write_text("VALUE = 'from-working-dir'\n")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(mod_dir)})
+    def use_mod():
+        import rtpu_proj_mod
+
+        return rtpu_proj_mod.VALUE, os.getcwd()
+
+    val, cwd = ray_tpu.get(use_mod.remote(), timeout=60)
+    assert val == "from-working-dir"
+    assert cwd == str(mod_dir)
+
+
+def test_validation_rejects_unsupported(cluster):
+    with pytest.raises(ValueError, match="not supported"):
+        RuntimeEnv(pip=["requests"])
+    with pytest.raises(ValueError, match="unknown runtime_env field"):
+        RuntimeEnv(bogus=1)
+    with pytest.raises(TypeError):
+        RuntimeEnv(env_vars={"A": 1})
+
+    with pytest.raises(ValueError):
+        @ray_tpu.remote(runtime_env={"conda": "env"})
+        def f():
+            return 1
