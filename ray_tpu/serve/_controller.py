@@ -1,0 +1,271 @@
+"""ServeController: the Serve control plane, one detached actor.
+
+Reference: python/ray/serve/_private/controller.py:86 (ServeController),
+application_state.py / deployment_state.py (state machines),
+autoscaling_state.py (queue-metric autoscaling).  Same shape, condensed: the
+controller holds the declarative app spec, and a reconcile loop drives the
+actual replica actors toward it — creating, replacing dead ones, and scaling
+counts from replica-reported ongoing-request stats.
+
+Threading note: this is a SYNC actor — its methods run on executor threads
+where blocking runtime calls (actor creation, get, kill) are legal; the
+reconcile loop is a daemon thread for the same reason.  An async design would
+deadlock: async actor methods run on the worker's IO loop, and actor creation
+blocks on that loop.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.serve._replica import ServeReplica
+from ray_tpu.serve.config import DeploymentConfig
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+_RECONCILE_PERIOD_S = 0.25
+
+
+class _DeploymentState:
+    def __init__(self, name: str, spec: dict):
+        self.name = name
+        self.spec = spec            # serialized_cls, init_args/kwargs, config
+        self.config: DeploymentConfig = spec["config"]
+        self.replicas: List[Any] = []
+        self.target = (self.config.autoscaling_config.min_replicas
+                       if self.config.autoscaling_config
+                       else self.config.num_replicas)
+        self.scale_signal_since: Optional[float] = None
+        self.last_health_check = 0.0
+
+
+@ray_tpu.remote(num_cpus=0)
+class ServeController:
+    def __init__(self):
+        self._apps: Dict[str, Dict[str, _DeploymentState]] = {}
+        self._ingress: Dict[str, str] = {}       # app -> ingress deployment
+        self._routes: Dict[str, str] = {}        # route_prefix -> app
+        self._proxy = None
+        self._proxy_port: Optional[int] = None
+        self._shutting_down = False
+        self._lock = threading.RLock()
+        # Serializes whole reconcile passes: deploy/delete call _reconcile_once
+        # from the controller executor thread while the daemon loop runs its
+        # own — concurrent passes would double-provision the same deficit.
+        self._reconcile_mutex = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._reconcile_loop, daemon=True,
+            name="serve-controller-reconcile")
+        self._thread.start()
+
+    # ------------------------------------------------------------ deploy API
+    def deploy_application(self, name: str, deployments: List[dict],
+                           ingress: str, route_prefix: Optional[str]):
+        """Declare (or redeclare) an app; reconcile makes it real."""
+        to_stop = []
+        with self._lock:
+            new = {}
+            old = self._apps.get(name, {})
+            for spec in deployments:
+                d = _DeploymentState(spec["name"], spec)
+                prev = old.pop(spec["name"], None)
+                if prev is not None and prev.spec["version"] == spec["version"]:
+                    d.replicas = prev.replicas      # unchanged: keep replicas
+                    d.target = prev.target
+                elif prev is not None:
+                    to_stop.append(prev)            # code/config changed
+                new[spec["name"]] = d
+            to_stop.extend(old.values())            # removed from the app
+            self._apps[name] = new
+            self._ingress[name] = ingress
+            if route_prefix is not None:
+                self._routes = {p: a for p, a in self._routes.items()
+                                if a != name}
+                self._routes[route_prefix] = name
+        for d in to_stop:
+            self._stop_replicas(d)
+        self._reconcile_once()
+        return True
+
+    def delete_application(self, name: str):
+        with self._lock:
+            app = self._apps.pop(name, None)
+            self._ingress.pop(name, None)
+            self._routes = {p: a for p, a in self._routes.items() if a != name}
+        if app:
+            for d in app.values():
+                self._stop_replicas(d)
+        return True
+
+    def shutdown(self):
+        self._shutting_down = True
+        for name in list(self._apps):
+            self.delete_application(name)
+        if self._proxy is not None:
+            try:
+                ray_tpu.kill(self._proxy)
+            except Exception:
+                pass
+            self._proxy = None
+        return True
+
+    # ------------------------------------------------------------- queries
+    def get_replicas(self, app: str, deployment: str) -> List[Any]:
+        with self._lock:
+            d = self._apps.get(app, {}).get(deployment)
+            return list(d.replicas) if d else []
+
+    def get_ingress(self, app: str) -> Optional[str]:
+        return self._ingress.get(app)
+
+    def get_routes(self) -> Dict[str, str]:
+        return dict(self._routes)
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                app: {name: {"target": d.target, "running": len(d.replicas)}
+                      for name, d in deps.items()}
+                for app, deps in self._apps.items()
+            }
+
+    def ensure_proxy(self, host: str, port: int) -> int:
+        if self._proxy is None:
+            from ray_tpu.serve._proxy import ProxyActor
+
+            self._proxy = ProxyActor.options(num_cpus=0).remote(host, port)
+            self._proxy_port = ray_tpu.get(self._proxy.ready.remote(),
+                                           timeout=60)
+        return self._proxy_port
+
+    # ---------------------------------------------------------- reconcile
+    def _reconcile_loop(self):
+        while not self._shutting_down:
+            try:
+                self._reconcile_once()
+            except Exception:
+                logger.exception("serve reconcile iteration failed")
+            time.sleep(_RECONCILE_PERIOD_S)
+
+    def _reconcile_once(self):
+        with self._reconcile_mutex:
+            with self._lock:
+                work = [(app, d) for app, deps in self._apps.items()
+                        for d in deps.values()]
+            for app, d in work:
+                self._health_check(d)
+                self._autoscale(d)
+                with self._lock:
+                    missing = d.target - len(d.replicas)
+                    surplus = [d.replicas.pop() for _ in
+                               range(len(d.replicas) - d.target)] \
+                        if len(d.replicas) > d.target else []
+                for _ in range(max(missing, 0)):
+                    r = self._start_replica(app, d)
+                    with self._lock:
+                        # A redeploy may have swapped this state out while we
+                        # were creating: don't leak the replica onto a
+                        # discarded _DeploymentState.
+                        if self._apps.get(app, {}).get(d.name) is d:
+                            d.replicas.append(r)
+                        else:
+                            surplus.append(r)
+                for victim in surplus:
+                    self._stop_one(victim)
+
+    def _start_replica(self, app: str, d: _DeploymentState):
+        opts = dict(d.config.ray_actor_options or {})
+        opts.setdefault("num_cpus", 0)
+        return ServeReplica.options(**opts).remote(
+            d.spec["serialized_cls"], d.spec["init_args"],
+            d.spec["init_kwargs"], d.config.max_ongoing_requests)
+
+    def _health_check(self, d: _DeploymentState):
+        now = time.monotonic()
+        if now - d.last_health_check < d.config.health_check_period_s:
+            return
+        d.last_health_check = now
+        with self._lock:
+            replicas = list(d.replicas)
+        dead = []
+        for r in replicas:
+            try:
+                ray_tpu.get(r.ping.remote(),
+                            timeout=d.config.health_check_timeout_s)
+            except Exception:
+                logger.warning("serve replica failed health check; replacing")
+                dead.append(r)
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+        if dead:
+            with self._lock:
+                d.replicas = [r for r in d.replicas if r not in dead]
+
+    def _autoscale(self, d: _DeploymentState):
+        cfg = d.config.autoscaling_config
+        if cfg is None or not d.replicas:
+            return
+        total_ongoing = 0
+        for r in list(d.replicas):
+            try:
+                st = ray_tpu.get(r.stats.remote(), timeout=5)
+                total_ongoing += st["ongoing"]
+            except Exception:
+                pass
+        desired = max(
+            cfg.min_replicas,
+            min(cfg.max_replicas,
+                round(total_ongoing / cfg.target_ongoing_requests) or
+                cfg.min_replicas))
+        now = time.monotonic()
+        if desired == d.target:
+            d.scale_signal_since = None
+            return
+        delay = (cfg.upscale_delay_s if desired > d.target
+                 else cfg.downscale_delay_s)
+        if d.scale_signal_since is None:
+            d.scale_signal_since = now
+        if now - d.scale_signal_since >= delay:
+            logger.info("autoscaling %s: %d -> %d (ongoing=%d)",
+                        d.name, d.target, desired, total_ongoing)
+            d.target = desired
+            d.scale_signal_since = None
+
+    def _stop_one(self, replica):
+        """Graceful stop: let in-flight requests finish, then kill (reference:
+        replica draining on scale-down)."""
+        try:
+            ray_tpu.get(replica.drain.remote(timeout_s=5.0), timeout=10)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(replica)
+        except Exception:
+            pass
+
+    def _stop_replicas(self, d: _DeploymentState):
+        with self._lock:
+            replicas, d.replicas = list(d.replicas), []
+        for r in replicas:
+            self._stop_one(r)
+
+
+def get_controller(create: bool = False):
+    """Look up (or start) the singleton controller actor."""
+    from ray_tpu.actor import get_actor
+
+    try:
+        return get_actor(CONTROLLER_NAME)
+    except ValueError:
+        if not create:
+            raise RuntimeError(
+                "Serve is not running on this cluster (serve.run first)")
+    return ServeController.options(
+        name=CONTROLLER_NAME, lifetime="detached").remote()

@@ -1,0 +1,104 @@
+"""HTTP proxy: routes requests to application ingress deployments.
+
+Reference: python/ray/serve/_private/proxy.py (HTTPProxy :766, ProxyActor
+:1139), condensed to the aiohttp equivalent: longest-prefix route match,
+JSON/text body handling, handle-based fan-in to replicas.  gRPC ingress is
+out of scope (the reference's gRPCProxy); the Python handle path covers
+in-cluster composition.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Dict, Optional
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+@ray_tpu.remote(num_cpus=0)
+class ProxyActor:
+    def __init__(self, host: str, port: int):
+        self._host = host
+        self._port = port
+        self._site = None
+        self._handles: Dict[str, object] = {}
+
+    async def ready(self) -> int:
+        """Start the aiohttp server; returns the bound port."""
+        if self._site is not None:
+            return self._port
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, self._host, self._port)
+        await site.start()
+        self._site = site
+        # port 0 -> discover the bound port
+        for sock in site._server.sockets:  # type: ignore[union-attr]
+            self._port = sock.getsockname()[1]
+            break
+        logger.info("serve proxy listening on %s:%d", self._host, self._port)
+        return self._port
+
+    async def _handle(self, request):
+        """aiohttp handler — runs on the worker's IO loop, so everything that
+        touches the runtime (controller lookup, handle routing, get) is
+        offloaded to executor threads where blocking calls are legal."""
+        from aiohttp import web
+
+        path = "/" + request.match_info["tail"]
+        body: object
+        if request.can_read_body:
+            raw = await request.read()
+            if request.content_type == "application/json":
+                body = json.loads(raw) if raw else None
+            else:
+                body = raw.decode() if raw else ""
+        else:
+            body = None
+        loop = asyncio.get_event_loop()
+        try:
+            out = await loop.run_in_executor(
+                None, self._route_and_call, path, body)
+        except LookupError:
+            return web.Response(status=404, text="no route")
+        except Exception as e:
+            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        if isinstance(out, (dict, list)):
+            return web.json_response(out)
+        if isinstance(out, bytes):
+            return web.Response(body=out)
+        return web.Response(text=str(out))
+
+    def _route_and_call(self, path: str, body):
+        from ray_tpu.serve._controller import get_controller
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        ctrl = get_controller()
+        routes = ray_tpu.get(ctrl.get_routes.remote(), timeout=30)
+        # longest matching prefix wins (reference: proxy route resolution)
+        best = None
+        for prefix, app_name in routes.items():
+            if path == prefix or path.startswith(prefix.rstrip("/") + "/") \
+                    or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, app_name)
+        if best is None:
+            raise LookupError(path)
+        app_name = best[1]
+        # keyed by (app, ingress): a redeploy can change the ingress
+        # deployment, and a handle cached on app name alone would route 500s
+        ingress = ray_tpu.get(ctrl.get_ingress.remote(app_name), timeout=30)
+        key = (app_name, ingress)
+        handle = self._handles.get(key)
+        if handle is None:
+            handle = DeploymentHandle(app_name, ingress)
+            self._handles[key] = handle
+        return handle.remote(body).result(60.0)

@@ -1,0 +1,95 @@
+"""Replica actor: hosts one instance of a deployment's user class.
+
+Reference: python/ray/serve/_private/replica.py — the replica wraps the user
+callable, enforces max_ongoing_requests, exposes health checks and stats.
+TPU note: a replica is the natural unit that owns a chip (or a mesh slice);
+the user class jit-compiles once in __init__ and every request hits the
+compiled function, so the request path stays out of Python-compile land.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Dict, Optional
+
+import ray_tpu
+
+
+@ray_tpu.remote
+class ServeReplica:
+    def __init__(self, serialized_cls: bytes, init_args, init_kwargs,
+                 max_ongoing_requests: int):
+        import cloudpickle
+
+        cls = cloudpickle.loads(serialized_cls)
+        self._user = cls(*init_args, **(init_kwargs or {}))
+        self._max_ongoing = max_ongoing_requests
+        self._ongoing = 0
+        self._total = 0
+        self._started_at = time.time()
+
+    async def handle_request(self, method: str, args, kwargs) -> Any:
+        """Run one request through the user callable.  The handle-level router
+        already respects max_ongoing_requests; the replica enforces it again
+        as a backstop (reference: replica backpressure).
+
+        Sync user code runs on an executor thread: this method itself runs on
+        the worker's IO loop, and user code may make blocking runtime calls
+        (composition: handle.remote().result()) that must not block the loop.
+        Async user code (incl. @serve.batch wrappers) stays on the loop."""
+        while self._ongoing >= self._max_ongoing:
+            await asyncio.sleep(0.005)
+        self._ongoing += 1
+        self._total += 1
+        try:
+            call = getattr(self._user, method, None)
+            if call is None:
+                raise AttributeError(f"deployment has no method {method!r}")
+            kwargs = kwargs or {}
+            args, kwargs = await self._resolve_refs(args, kwargs)
+            if inspect.iscoroutinefunction(call):
+                out = call(*args, **kwargs)
+            else:
+                loop = asyncio.get_event_loop()
+                out = await loop.run_in_executor(
+                    None, lambda: call(*args, **kwargs))
+            if inspect.isawaitable(out):
+                out = await out
+            return out
+        finally:
+            self._ongoing -= 1
+
+    async def _resolve_refs(self, args, kwargs):
+        """Resolve top-level ObjectRefs (chained DeploymentResponses) to
+        values, mirroring actor-call argument semantics (reference: handles
+        pass the upstream ref; the downstream replica awaits it)."""
+        from ray_tpu._private.object_ref import ObjectRef
+        from ray_tpu._private.worker import get_async
+
+        args = list(args)
+        for i, a in enumerate(args):
+            if isinstance(a, ObjectRef):
+                args[i] = await get_async(a)
+        kwargs = dict(kwargs)
+        for k, v in list(kwargs.items()):
+            if isinstance(v, ObjectRef):
+                kwargs[k] = await get_async(v)
+        return tuple(args), kwargs
+
+    def stats(self) -> Dict[str, Any]:
+        return {"ongoing": self._ongoing, "total": self._total,
+                "uptime_s": time.time() - self._started_at}
+
+    def ping(self) -> bool:
+        check = getattr(self._user, "check_health", None)
+        if check is not None:
+            check()
+        return True
+
+    async def drain(self, timeout_s: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while self._ongoing > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        return self._ongoing == 0

@@ -1,0 +1,182 @@
+"""DeploymentHandle: the Python-native request path into a deployment.
+
+Reference: python/ray/serve/handle.py (DeploymentHandle :729,
+DeploymentResponse :801) + the router's power-of-two-choices replica pick
+(python/ray/serve/_private/replica_scheduler/pow_2_scheduler.py:51).
+
+The handle is address-only (app + deployment names) so it pickles freely into
+other deployments (model composition) and driver code; the replica set is
+fetched from the controller lazily and refreshed on a period or on failure.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.exceptions import RayActorError
+
+_REFRESH_PERIOD_S = 2.0
+
+
+class DeploymentResponse:
+    """Future for one request (reference: DeploymentResponse).  Chains into
+    other handle calls by passing the underlying ObjectRef."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def _to_object_ref(self):
+        return self._ref
+
+    def __await__(self):
+        from ray_tpu._private.worker import get_async
+
+        return get_async(self._ref).__await__()
+
+
+class _Router:
+    """Per-handle replica picker: power-of-two-choices on locally tracked
+    in-flight counts (reference: pow_2_scheduler.py:51 — two random replicas,
+    route to the less loaded)."""
+
+    def __init__(self):
+        self._inflight: Dict[bytes, int] = {}
+        self._lock = threading.Lock()
+
+    def pick(self, replicas: List[Any]):
+        if not replicas:
+            raise RuntimeError("no replicas available")
+        with self._lock:
+            if len(replicas) == 1:
+                choice = replicas[0]
+            else:
+                a, b = random.sample(replicas, 2)
+                ka, kb = a._actor_id.binary(), b._actor_id.binary()
+                choice = a if self._inflight.get(ka, 0) <= self._inflight.get(kb, 0) else b
+            k = choice._actor_id.binary()
+            self._inflight[k] = self._inflight.get(k, 0) + 1
+            return choice
+
+    def done(self, replica) -> None:
+        with self._lock:
+            k = replica._actor_id.binary()
+            n = self._inflight.get(k, 0)
+            if n <= 1:
+                self._inflight.pop(k, None)
+            else:
+                self._inflight[k] = n - 1
+
+
+class DeploymentHandle:
+    def __init__(self, app_name: str, deployment_name: str,
+                 method_name: str = "__call__"):
+        self._app = app_name
+        self._deployment = deployment_name
+        self._method = method_name
+        self._init_local()
+
+    def _init_local(self):
+        self._router = _Router()
+        self._replicas: List[Any] = []
+        self._fetched_at = 0.0
+        self._lock = threading.Lock()
+
+    # handles pickle into other deployments: drop the live local state
+    def __reduce__(self):
+        return (DeploymentHandle, (self._app, self._deployment, self._method))
+
+    def options(self, *, method_name: str) -> "DeploymentHandle":
+        h = DeploymentHandle(self._app, self._deployment, method_name)
+        return h
+
+    @property
+    def method(self):
+        return self._method
+
+    def _controller(self):
+        from ray_tpu.serve._controller import get_controller
+
+        return get_controller()
+
+    def _get_replicas(self, force: bool = False) -> List[Any]:
+        now = time.monotonic()
+        with self._lock:
+            if (not force and self._replicas
+                    and now - self._fetched_at < _REFRESH_PERIOD_S):
+                return self._replicas
+        ctrl = self._controller()
+        deadline = time.monotonic() + 30.0
+        while True:
+            replicas = ray_tpu.get(
+                ctrl.get_replicas.remote(self._app, self._deployment),
+                timeout=30)
+            if replicas:
+                with self._lock:
+                    self._replicas = replicas
+                    self._fetched_at = time.monotonic()
+                return replicas
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"no replicas for {self._app}/{self._deployment}")
+            time.sleep(0.1)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        # Chain composition: unwrap nested responses into their refs so the
+        # downstream replica awaits the upstream result, not a wrapper.
+        args = tuple(a._to_object_ref() if isinstance(a, DeploymentResponse)
+                     else a for a in args)
+        kwargs = {k: (v._to_object_ref() if isinstance(v, DeploymentResponse)
+                      else v) for k, v in kwargs.items()}
+        return self._call(args, kwargs, retries=2)
+
+    def _call(self, args, kwargs, retries: int) -> "_TrackedResponse":
+        replicas = self._get_replicas(force=retries < 2)
+        replica = self._router.pick(replicas)
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+        # Router accounting keyed to RESULT ARRIVAL (memory-store ready
+        # callback), not to result() being called — fire-and-forget and
+        # awaited responses must release in-flight slots too.
+        from ray_tpu._private import worker as worker_mod
+
+        core = worker_mod.require_core()
+        released = {"done": False}
+
+        def release():
+            if not released["done"]:
+                released["done"] = True
+                self._router.done(replica)
+
+        if core.memory_store.add_ready_callback(ref.oid, release):
+            release()  # already completed
+        return _TrackedResponse(ref, self, args, kwargs, retries)
+
+
+class _TrackedResponse(DeploymentResponse):
+    """Response that retries through a FRESH replica when the picked one died
+    before answering (the controller replaces dead replicas; the handle's
+    cached replica set can be up to _REFRESH_PERIOD_S stale)."""
+
+    def __init__(self, ref, handle: "DeploymentHandle", args, kwargs,
+                 retries: int):
+        super().__init__(ref)
+        self._handle = handle
+        self._args = args
+        self._kwargs = kwargs
+        self._retries = retries
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        try:
+            return super().result(timeout_s)
+        except RayActorError:
+            if self._retries <= 0:
+                raise
+            retry = self._handle._call(self._args, self._kwargs,
+                                       self._retries - 1)
+            return retry.result(timeout_s)

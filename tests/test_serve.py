@@ -1,0 +1,187 @@
+"""Serve end-to-end: deployments, handles, composition, HTTP, batching,
+replica replacement (reference test strategy: python/ray/serve/tests/ with
+the shared serve_instance fixture, conftest.py:96-132)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_instance():
+    from conftest import ensure_shared_runtime
+
+    rt = ensure_shared_runtime()
+    yield rt
+    serve.shutdown()
+
+
+def test_deploy_and_handle(serve_instance):
+    @serve.deployment
+    class Echo:
+        def __call__(self, x):
+            return {"echo": x}
+
+    h = serve.run(Echo.bind(), name="echo-app")
+    assert h.remote("hi").result(30) == {"echo": "hi"}
+    assert serve.status()["echo-app"]["Echo"]["running"] == 1
+    serve.delete("echo-app")
+
+
+def test_multiple_replicas_and_methods(serve_instance):
+    @serve.deployment(num_replicas=2)
+    class Counter:
+        def __init__(self, start):
+            self.start = start
+
+        def __call__(self, x):
+            return self.start + x
+
+        def double(self, x):
+            return 2 * x
+
+    h = serve.run(Counter.bind(100), name="counter")
+    outs = [h.remote(i).result(30) for i in range(10)]
+    assert outs == [100 + i for i in range(10)]
+    d = h.options(method_name="double")
+    assert d.remote(21).result(30) == 42
+    # both replicas stood up
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if serve.status()["counter"]["Counter"]["running"] == 2:
+            break
+        time.sleep(0.2)
+    assert serve.status()["counter"]["Counter"]["running"] == 2
+    serve.delete("counter")
+
+
+def test_composition(serve_instance):
+    @serve.deployment
+    class Preprocess:
+        def __call__(self, x):
+            return x * 10
+
+    @serve.deployment
+    class Model:
+        def __init__(self, pre):
+            self.pre = pre
+
+        def __call__(self, x):
+            y = self.pre.remote(x).result(30)
+            return y + 1
+
+    app = Model.bind(Preprocess.bind())
+    h = serve.run(app, name="composed")
+    assert h.remote(4).result(30) == 41
+    serve.delete("composed")
+
+
+def test_http_proxy(serve_instance):
+    import json
+    import urllib.request
+
+    @serve.deployment
+    class Api:
+        def __call__(self, body):
+            return {"got": body}
+
+    serve.run(Api.bind(), name="api", route_prefix="/api")
+    port = serve.start(http_port=0)  # 0 -> pick a free port
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api", method="POST",
+        data=json.dumps({"k": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        out = json.loads(resp.read())
+    assert out == {"got": {"k": 1}}
+
+    # unknown route -> 404
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/nope", timeout=30)
+        assert False, "expected 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    serve.delete("api")
+
+
+def test_batching(serve_instance):
+    @serve.deployment
+    class Batched:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+        async def __call__(self, xs):
+            # whole batch arrives as one list call
+            return [{"x": x, "batch": len(xs)} for x in xs]
+
+    h = serve.run(Batched.bind(), name="batched")
+    resps = [h.remote(i) for i in range(4)]
+    outs = [r.result(30) for r in resps]
+    assert [o["x"] for o in outs] == list(range(4))
+    # at least one multi-element batch formed
+    assert max(o["batch"] for o in outs) >= 2
+    serve.delete("batched")
+
+
+def test_replica_replaced_after_death(serve_instance):
+    @serve.deployment
+    class Fragile:
+        def __call__(self, x):
+            return x
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+    h = serve.run(Fragile.bind(), name="fragile")
+    pid = h.options(method_name="pid").remote().result(30)
+    import os
+    import signal
+
+    os.kill(pid, signal.SIGKILL)
+    # controller health-check replaces the replica; handle recovers
+    deadline = time.monotonic() + 60
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            new_pid = h.options(method_name="pid").remote().result(10)
+            if new_pid != pid:
+                break
+        except Exception as e:
+            last = e
+        time.sleep(0.5)
+    else:
+        raise AssertionError(f"replica never replaced: {last}")
+    assert h.remote("ok").result(30) == "ok"
+    serve.delete("fragile")
+
+
+def test_autoscaling_up(serve_instance):
+    from ray_tpu.serve import AutoscalingConfig
+
+    @serve.deployment(autoscaling_config=AutoscalingConfig(
+        min_replicas=1, max_replicas=3, target_ongoing_requests=1.0,
+        upscale_delay_s=0.3))
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.4)
+            return x
+
+    h = serve.run(Slow.bind(), name="auto")
+    assert serve.status()["auto"]["Slow"]["running"] == 1
+    # sustained concurrent load: queue depth >> target drives scale-up
+    resps = [h.remote(i) for i in range(24)]
+    deadline = time.monotonic() + 60
+    grew = False
+    while time.monotonic() < deadline:
+        if serve.status()["auto"]["Slow"]["running"] > 1:
+            grew = True
+            break
+        time.sleep(0.2)
+    assert [r.result(120) for r in resps] == list(range(24))
+    assert grew, "deployment never scaled up under load"
+    serve.delete("auto")
