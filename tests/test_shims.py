@@ -1,0 +1,96 @@
+"""ActorPool / Queue / multiprocessing.Pool shims (reference:
+python/ray/util/{actor_pool,queue}.py, util/multiprocessing/pool.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@pytest.fixture
+def cluster():
+    from conftest import ensure_shared_runtime
+
+    yield ensure_shared_runtime()
+
+
+@ray_tpu.remote
+class Doubler:
+    def double(self, x):
+        return 2 * x
+
+
+def test_actor_pool_ordered_and_unordered(cluster):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    outs = list(pool.map(lambda a, v: a.double.remote(v), range(8)))
+    assert outs == [2 * i for i in range(8)]
+    outs = sorted(pool.map_unordered(lambda a, v: a.double.remote(v),
+                                     range(8)))
+    assert outs == sorted(2 * i for i in range(8))
+
+
+def test_actor_pool_submit_get(cluster):
+    pool = ActorPool([Doubler.remote()])
+    pool.submit(lambda a, v: a.double.remote(v), 5)
+    assert pool.has_next()
+    assert pool.get_next(timeout=30) == 10
+    assert not pool.has_next()
+
+
+def test_queue_basic(cluster):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.qsize() == 2
+    assert q.full()
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.get() == 1
+    assert q.get() == 2
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get_nowait()
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.put_nowait_batch([7, 8])
+    assert q.get_nowait_batch(2) == [7, 8]
+    q.shutdown()
+
+
+def test_queue_cross_actor(cluster):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    ref = producer.remote(q, 5)
+    got = [q.get(timeout=30) for _ in range(5)]
+    assert got == list(range(5))
+    assert ray_tpu.get(ref, timeout=30)
+    q.shutdown()
+
+
+def _sq(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def test_multiprocessing_pool(cluster):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(processes=2) as p:
+        assert p.map(_sq, range(10)) == [i * i for i in range(10)]
+        assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+        assert p.apply(_add, (5, 6)) == 11
+        r = p.map_async(_sq, range(4))
+        assert r.get(timeout=30) == [0, 1, 4, 9]
+        assert sorted(p.imap_unordered(_sq, range(6))) == \
+            sorted(i * i for i in range(6))
+        assert list(p.imap(_sq, range(6))) == [i * i for i in range(6)]
