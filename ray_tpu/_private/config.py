@@ -113,6 +113,9 @@ _d("object_recovery_max_attempts", int, 3, "reconstruction attempts per lost obj
 _d("fetch_chunk_bytes", int, 8 * 1024**2, "chunk size for node-to-node object transfer")
 
 # --- Fault tolerance ---
+_d("gcs_storage_path", str, "", "sqlite file for GCS persistence; empty = in-memory only")
+_d("gcs_reconnect_timeout_s", float, 60.0, "nodelets/workers retry the GCS connection this long")
+_d("gcs_restart_actor_grace_s", float, 10.0, "restarted GCS waits this long for nodes to re-report actors before declaring them failed")
 _d("task_max_retries_default", int, 3, "default retries for tasks (on worker/node death)")
 _d("actor_max_restarts_default", int, 0, "default actor restarts")
 _d("lineage_enabled", bool, True, "enable lineage-based object recovery")

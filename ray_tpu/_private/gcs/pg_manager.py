@@ -35,7 +35,7 @@ STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
 
 class PgInfo:
     __slots__ = ("pg_id", "bundles", "strategy", "name", "state", "bundle_nodes",
-                 "ready_event", "creator_job", "detached")
+                 "ready_event", "creator_job", "detached", "scheduling")
 
     def __init__(self, pg_id, bundles, strategy, name, creator_job, detached):
         self.pg_id: PlacementGroupID = pg_id
@@ -47,6 +47,7 @@ class PgInfo:
         self.ready_event = asyncio.Event()
         self.creator_job = creator_job
         self.detached = detached
+        self.scheduling = False  # a _schedule_loop task is live (single-flight)
 
     def info(self) -> dict:
         return {
@@ -58,12 +59,104 @@ class PgInfo:
             "bundle_nodes": list(self.bundle_nodes),
         }
 
+    def to_record(self) -> dict:
+        rec = self.info()
+        rec["creator_job"] = self.creator_job
+        rec["detached"] = self.detached
+        return rec
+
+    @classmethod
+    def from_record(cls, rec: dict) -> "PgInfo":
+        pg = cls(PlacementGroupID(rec["pg_id"]), rec["bundles"],
+                 rec["strategy"], rec["name"], rec["creator_job"],
+                 rec["detached"])
+        pg.state = rec["state"]
+        pg.bundle_nodes = list(rec["bundle_nodes"])
+        if pg.state == "CREATED":
+            pg.ready_event.set()
+        return pg
+
 
 class PlacementGroupManager:
     def __init__(self, gcs):
         self.gcs = gcs
         self.groups: Dict[PlacementGroupID, PgInfo] = {}
         self._pending: List[PlacementGroupID] = []
+
+    def _spawn_schedule(self, pg: PgInfo):
+        """At most ONE _schedule_loop per group: concurrent loops would race
+        2PC bundle placement against each other (each can be mid-prepare on
+        different nodes for the same index)."""
+        if pg.scheduling:
+            return
+        pg.scheduling = True
+
+        async def _run():
+            try:
+                await self._schedule_loop(pg)
+            finally:
+                pg.scheduling = False
+
+        asyncio.get_event_loop().create_task(_run())
+
+    # ------------------------------------------------------- persistence
+    def _persist(self, pg: PgInfo):
+        store = getattr(self.gcs, "store", None)
+        if store is not None and store.persistent:
+            import pickle
+
+            if pg.state == "REMOVED":
+                store.delete("placement_groups", pg.pg_id.hex())
+            else:
+                store.put("placement_groups", pg.pg_id.hex(),
+                          pickle.dumps(pg.to_record()))
+
+    def load_from_store(self, store):
+        if not store.persistent:
+            return
+        import pickle
+
+        for _, blob in store.get_all("placement_groups").items():
+            pg = PgInfo.from_record(pickle.loads(blob))
+            self.groups[pg.pg_id] = pg
+            if pg.state in ("PENDING", "RESCHEDULING"):
+                self._spawn_schedule(pg)
+
+    def reconcile_after_restart(self, alive_node_ids: set):
+        """Post-restart sweep: bundles restored onto nodes that never
+        re-registered are lost — clear them and reschedule (the normal
+        on_node_dead path can't fire for nodes the restarted GCS never saw)."""
+        for pg in self.groups.values():
+            if pg.state not in ("CREATED", "PENDING", "RESCHEDULING"):
+                continue
+            lost = [i for i, n in enumerate(pg.bundle_nodes)
+                    if n is not None and n not in alive_node_ids]
+            if lost:
+                for i in lost:
+                    pg.bundle_nodes[i] = None
+                pg.state = "RESCHEDULING"
+                pg.ready_event.clear()
+                self._persist(pg)
+                logger.warning(
+                    "placement group %s lost %d bundle(s) across GCS "
+                    "restart; rescheduling", pg.pg_id.hex()[:12], len(lost))
+                self._spawn_schedule(pg)
+
+    def reconcile_bundle(self, pg_id_bin: bytes, index: int,
+                         node_id_bin: bytes):
+        """A re-registering node reports a bundle it still holds (after a GCS
+        restart the restored pg record should already agree; this heals any
+        divergence)."""
+        pg = self.groups.get(PlacementGroupID(pg_id_bin))
+        if pg is None or pg.state == "REMOVED":
+            return
+        if 0 <= index < len(pg.bundle_nodes):
+            pg.bundle_nodes[index] = node_id_bin
+            if all(n is not None for n in pg.bundle_nodes) \
+                    and pg.state in ("PENDING", "RESCHEDULING", "CREATED"):
+                pg.state = "CREATED"
+                pg.ready_event.set()
+            self._persist(pg)
 
     # ---------------------------------------------------------------- public
     async def create(self, msg) -> dict:
@@ -74,7 +167,8 @@ class PlacementGroupManager:
         pg = PgInfo(pg_id, msg["bundles"], strategy, msg.get("name", ""),
                     msg.get("job_id"), msg.get("detached", False))
         self.groups[pg_id] = pg
-        asyncio.get_event_loop().create_task(self._schedule_loop(pg))
+        self._persist(pg)
+        self._spawn_schedule(pg)
         return {"pg_id": pg_id.binary()}
 
     async def remove(self, pg_id: PlacementGroupID) -> bool:
@@ -82,6 +176,7 @@ class PlacementGroupManager:
         if pg is None:
             return False
         pg.state = "REMOVED"
+        self._persist(pg)
         await self._release_bundles(pg, range(len(pg.bundles)))
         await self.gcs.publish("placement_group", pg.info())
         return True
@@ -129,7 +224,8 @@ class PlacementGroupManager:
                     pg.bundle_nodes[i] = None
                 pg.state = "RESCHEDULING"
                 pg.ready_event.clear()
-                asyncio.get_event_loop().create_task(self._schedule_loop(pg))
+                self._persist(pg)
+                self._spawn_schedule(pg)
 
     # -------------------------------------------------------------- internal
     def _alive_nodes(self):
@@ -211,6 +307,7 @@ class PlacementGroupManager:
                 if ok:
                     pg.state = "CREATED"
                     pg.ready_event.set()
+                    self._persist(pg)
                     await self.gcs.publish("placement_group", pg.info())
                     return
             await asyncio.sleep(0.2)

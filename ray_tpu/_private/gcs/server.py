@@ -103,6 +103,32 @@ class ActorInfo:
         self.holders: set = set()
         self.had_holder = False
 
+    def to_record(self) -> dict:
+        """Persistable snapshot (reference: GcsActorTableData)."""
+        return {
+            "actor_id": self.actor_id.binary(), "spec": self.spec,
+            "state": self.state, "addr": self.addr, "worker_id": self.worker_id,
+            "node_id": self.node_id, "name": self.name,
+            "namespace": self.namespace, "num_restarts": self.num_restarts,
+            "max_restarts": self.max_restarts, "death_cause": self.death_cause,
+            "class_name": self.class_name, "job_id": self.job_id,
+            "start_time": self.start_time, "detached": self.detached,
+        }
+
+    @classmethod
+    def from_record(cls, rec: dict) -> "ActorInfo":
+        info = cls(ActorID(rec["actor_id"]), rec["spec"], rec["name"],
+                   rec["namespace"], rec["max_restarts"], rec["class_name"],
+                   rec["job_id"], rec["detached"])
+        info.state = rec["state"]
+        info.addr = tuple(rec["addr"]) if rec["addr"] else None
+        info.worker_id = rec["worker_id"]
+        info.node_id = rec["node_id"]
+        info.num_restarts = rec["num_restarts"]
+        info.death_cause = rec["death_cause"]
+        info.start_time = rec["start_time"]
+        return info
+
     def public_info(self) -> dict:
         return {
             "actor_id": self.actor_id.binary(),
@@ -141,6 +167,94 @@ class GcsServer:
         from ray_tpu._private.gcs.pg_manager import PlacementGroupManager
 
         self.pg_manager = PlacementGroupManager(self)
+        # Persistence seam (reference: store_client.h:33).  With a sqlite
+        # path configured, actors/jobs/kv/PGs survive a GCS restart; nodes
+        # re-register over their reconnect loops and re-report live actors,
+        # bundles, and object locations (reference: GcsInitData replay +
+        # ray_syncer resync after GCS failover).
+        from ray_tpu._private.gcs.storage import make_store
+
+        self.store = make_store(RayConfig.gcs_storage_path or None)
+        self._restored_unconfirmed: Set[ActorID] = set()
+        self._load_from_store()
+
+    # ------------------------------------------------------------ persistence
+    def _load_from_store(self):
+        import pickle
+
+        if not self.store.persistent:
+            return
+        meta = self.store.get("meta", "next_job")
+        if meta is not None:
+            self.next_job = int(meta)
+        for key, blob in self.store.get_all("kv").items():
+            ns, _, k = key.partition("\x00")
+            self.kv.setdefault(ns, {})[k] = blob
+        for _, blob in self.store.get_all("jobs").items():
+            rec = pickle.loads(blob)
+            self.jobs[rec["job_id"]] = rec
+        restored_actors = 0
+        for _, blob in self.store.get_all("actors").items():
+            info = ActorInfo.from_record(pickle.loads(blob))
+            self.actors[info.actor_id] = info
+            if info.name:
+                self.named_actors[(info.namespace, info.name)] = info.actor_id
+            if info.state in ("ALIVE", "PENDING_CREATION", "RESTARTING"):
+                # Liveness unknown until the hosting node re-registers and
+                # re-reports it; the confirmation sweep reschedules unplaced
+                # actors and fails unreachable ones after a grace period.
+                self._restored_unconfirmed.add(info.actor_id)
+                restored_actors += 1
+        self.pg_manager.load_from_store(self.store)
+        if restored_actors or self.jobs or self.kv:
+            logger.info(
+                "GCS state restored: %d actors (%d awaiting confirmation), "
+                "%d jobs, %d kv namespaces, %d placement groups",
+                len(self.actors), restored_actors, len(self.jobs),
+                len(self.kv), len(self.pg_manager.groups))
+
+    def _persist_actor(self, info: ActorInfo):
+        if self.store.persistent:
+            import pickle
+
+            self.store.put("actors", info.actor_id.hex(),
+                           pickle.dumps(info.to_record()))
+
+    def _persist_job(self, rec: dict):
+        if self.store.persistent:
+            import pickle
+
+            self.store.put("jobs", rec["job_id"].hex(), pickle.dumps(rec))
+
+    async def _confirmation_sweep(self):
+        """After a restart, actors whose node never re-reported them within
+        the grace period go through the normal failure path (restart policy
+        applies) instead of staying ALIVE-but-unreachable forever."""
+        await asyncio.sleep(RayConfig.gcs_restart_actor_grace_s)
+        for actor_id in list(self._restored_unconfirmed):
+            info = self.actors.get(actor_id)
+            self._restored_unconfirmed.discard(actor_id)
+            if info is None:
+                continue
+            if info.state in ("PENDING_CREATION", "RESTARTING"):
+                # Never placed (or mid-restart) when the GCS died and no node
+                # re-reported it: just schedule it — this is not a failure, so
+                # it must not consume a restart.
+                logger.info("rescheduling restored actor %s (%s)",
+                            actor_id.hex()[:12], info.class_name)
+                asyncio.get_event_loop().create_task(
+                    self._schedule_actor(info))
+            elif info.state == "ALIVE":
+                logger.warning(
+                    "restored actor %s (%s) unconfirmed after GCS restart; "
+                    "driving failure path", actor_id.hex()[:12],
+                    info.class_name)
+                await self._handle_actor_failure(
+                    info, "hosting node did not re-report after GCS restart")
+        # Restored CREATED placement groups whose nodes never came back get
+        # their lost bundles rescheduled (same grace, same reasoning).
+        alive = {n.node_id.binary() for n in self.nodes.values() if n.alive}
+        self.pg_manager.reconcile_after_restart(alive)
 
     # ------------------------------------------------------------------ setup
     def _handlers(self) -> dict:
@@ -153,6 +267,9 @@ class GcsServer:
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
         self.addr = await self.server.start(host, port)
         self._bg.append(asyncio.get_event_loop().create_task(self._health_check_loop()))
+        if self._restored_unconfirmed or self.pg_manager.groups:
+            self._bg.append(asyncio.get_event_loop().create_task(
+                self._confirmation_sweep()))
         self._started.set()
         logger.info("GCS listening on %s:%s", *self.addr)
         return self.addr
@@ -239,13 +356,36 @@ class GcsServer:
         info.object_store_capacity = msg.get("object_store_capacity", 0)
         self.nodes[node_id] = info
         conn.context["node_id"] = node_id.binary()
+        # Re-registration after a GCS restart (or a dropped connection): the
+        # node re-reports its live actors, PG bundles, and local objects so
+        # restored state reconciles with reality (reference: raylets
+        # resync via ray_syncer after GCS failover).
+        for oid in msg.get("objects", []):
+            self.object_dir.setdefault(oid, set()).add(node_id.binary())
+        for b in msg.get("bundles", []):
+            self.pg_manager.reconcile_bundle(
+                b["pg_id"], b["index"], node_id.binary())
+        for a in msg.get("actors", []):
+            actor = self.actors.get(ActorID(a["actor_id"]))
+            if actor is not None and actor.state != "DEAD":
+                actor.state = "ALIVE"
+                actor.addr = tuple(a["worker_addr"])
+                actor.worker_id = a["worker_id"]
+                actor.node_id = node_id.binary()
+                self._restored_unconfirmed.discard(actor.actor_id)
+                self._persist_actor(actor)
         await self.publish("node", {"event": "added", "node": info.view()})
         return {"cluster_id": self.cluster_id, "cluster_view": self.cluster_view()}
 
     async def rpc_resource_report(self, conn, msg):
         node_id = NodeID(msg["node_id"])
         info = self.nodes.get(node_id)
-        if info is None or not info.alive:
+        if info is None:
+            # Not "dead": a restarted GCS simply hasn't seen this node's
+            # re-registration yet — telling it to re-register (not exit)
+            # is what makes GCS failover survivable.
+            return {"unknown": True}
+        if not info.alive:
             return {"dead": True}
         info.last_seen = time.monotonic()
         info.resources_available = msg["available"]
@@ -280,7 +420,7 @@ class GcsServer:
     async def rpc_register_job(self, conn, msg):
         job_id = JobID.from_int(self.next_job)
         self.next_job += 1
-        self.jobs[job_id.binary()] = {
+        rec = {
             "job_id": job_id.binary(),
             "driver_addr": msg.get("driver_addr"),
             "start_time": time.time(),
@@ -288,6 +428,10 @@ class GcsServer:
             "entrypoint": msg.get("entrypoint", ""),
             "metadata": msg.get("metadata", {}),
         }
+        self.jobs[job_id.binary()] = rec
+        if self.store.persistent:
+            self.store.put("meta", "next_job", str(self.next_job).encode())
+        self._persist_job(rec)
         conn.context["job_id"] = job_id.binary()
         return {"job_id": job_id.binary()}
 
@@ -296,6 +440,7 @@ class GcsServer:
         if j:
             j["status"] = msg.get("status", "SUCCEEDED")
             j["end_time"] = time.time()
+            self._persist_job(j)
         return True
 
     async def rpc_get_all_job_info(self, conn, msg):
@@ -303,10 +448,13 @@ class GcsServer:
 
     # ------------------------------------------------------------------- kv
     async def rpc_kv_put(self, conn, msg):
-        ns = self.kv.setdefault(msg.get("ns", ""), {})
+        ns_name = msg.get("ns", "")
+        ns = self.kv.setdefault(ns_name, {})
         existed = msg["key"] in ns
         if msg.get("overwrite", True) or not existed:
             ns[msg["key"]] = msg["value"]
+            if self.store.persistent:
+                self.store.put("kv", f"{ns_name}\x00{msg['key']}", msg["value"])
         return existed
 
     async def rpc_kv_get(self, conn, msg):
@@ -317,13 +465,19 @@ class GcsServer:
         return {k: ns[k] for k in msg["keys"] if k in ns}
 
     async def rpc_kv_del(self, conn, msg):
-        ns = self.kv.get(msg.get("ns", ""), {})
+        ns_name = msg.get("ns", "")
+        ns = self.kv.get(ns_name, {})
         if msg.get("prefix"):
             doomed = [k for k in ns if k.startswith(msg["key"])]
             for k in doomed:
                 del ns[k]
+                if self.store.persistent:
+                    self.store.delete("kv", f"{ns_name}\x00{k}")
             return len(doomed)
-        return 1 if ns.pop(msg["key"], None) is not None else 0
+        hit = ns.pop(msg["key"], None) is not None
+        if hit and self.store.persistent:
+            self.store.delete("kv", f"{ns_name}\x00{msg['key']}")
+        return 1 if hit else 0
 
     async def rpc_kv_keys(self, conn, msg):
         ns = self.kv.get(msg.get("ns", ""), {})
@@ -413,6 +567,7 @@ class GcsServer:
             class_name=spec.name, job_id=spec.job_id.binary(), detached=bool(msg.get("detached")),
         )
         self.actors[actor_id] = info
+        self._persist_actor(info)
         asyncio.get_event_loop().create_task(self._schedule_actor(info))
         return {"actor_id": actor_id.binary()}
 
@@ -486,6 +641,7 @@ class GcsServer:
             delay = min(delay * 1.5, 2.0)
 
     async def _publish_actor(self, info: ActorInfo):
+        self._persist_actor(info)  # every state transition flows through here
         await self.publish("actor", info.public_info())
         await self.publish(f"actor:{info.actor_id.hex()}", info.public_info())
 

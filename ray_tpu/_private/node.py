@@ -160,3 +160,22 @@ class Node:
         """Test hook: simulate node failure (reference: test_utils kill_raylet)."""
         if self.nodelet_proc is not None and self.nodelet_proc.poll() is None:
             self.nodelet_proc.kill()
+
+    def kill_gcs(self):
+        """Test hook: simulate GCS failure (reference: test_gcs_fault_tolerance
+        killing the gcs_server process)."""
+        if self.gcs_proc is not None and self.gcs_proc.poll() is None:
+            self.gcs_proc.kill()
+            self.gcs_proc.wait()
+
+    def restart_gcs(self):
+        """Restart the GCS on the SAME port; with persistence configured it
+        replays its tables and nodes/workers re-register over their reconnect
+        loops (reference: GCS FT restart with a Redis backend)."""
+        assert self.head and self.gcs_addr is not None
+        logs = os.path.join(self.session_dir, "logs")
+        self.gcs_proc, _ = _spawn_and_scrape(
+            [sys.executable, "-u", "-m", "ray_tpu._private.gcs.server",
+             "--port", str(self.gcs_addr[1])],
+            {"GCS_PORT"}, os.path.join(logs, "gcs.log"), env=self._env(),
+        )

@@ -47,7 +47,7 @@ class _LeaseCancelled(Exception):
 
 class WorkerHandle:
     __slots__ = ("worker_id", "proc", "conn", "addr", "pid", "state", "lease_id",
-                 "is_actor", "started_at", "idle_since")
+                 "is_actor", "actor_id", "started_at", "idle_since")
 
     def __init__(self, worker_id: bytes, proc: Optional[subprocess.Popen]):
         self.worker_id = worker_id
@@ -58,6 +58,7 @@ class WorkerHandle:
         self.state = "starting"  # starting -> idle -> leased | actor -> dead
         self.lease_id: Optional[int] = None
         self.is_actor = False
+        self.actor_id: Optional[bytes] = None  # hosting this actor (re-reported on GCS reconnect)
         self.started_at = time.monotonic()
         self.idle_since = time.monotonic()
 
@@ -139,32 +140,98 @@ class Nodelet:
         self.addr: Tuple[str, int] = ("", 0)
         self._bg: List[asyncio.Task] = []
         self._shutting_down = False
+        self._gcs_reconnecting = False
 
     # ------------------------------------------------------------------ boot
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
         self.addr = await self.server.start(host, port)
-        # Full handler table: the GCS calls back over this same connection
-        # (lease_worker_for_actor, prepare/commit/cancel_bundle, ...).
-        self.gcs = await rpc.connect(*self.gcs_addr, handlers=self.handlers,
-                                     name="nodelet->gcs")
-        resp = await self.gcs.call("register_node", {
-            "node_id": self.node_id.binary(),
-            "addr": list(self.addr),
-            "resources": self.resources_total,
-            "labels": self.labels,
-            "node_name": self.node_name,
-            "object_store_capacity": self.store.capacity,
-        })
-        for view in resp["cluster_view"]:
-            self.cluster_view[view["node_id"]] = view
-        await self.gcs.call("subscribe", {"channel": "resource_view"})
-        await self.gcs.call("subscribe", {"channel": "node"})
+        await self._connect_gcs()
+        if self.gcs.closed:  # dropped before _on_close was attached
+            self._on_gcs_lost(self.gcs)
         self._bg.append(asyncio.get_event_loop().create_task(self._report_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._monitor_workers_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._flush_dir_loop()))
         logger.info("nodelet %s on %s:%s resources=%s",
                     self.node_id.hex()[:8], *self.addr, self.resources_total)
         return self.addr
+
+    async def _connect_gcs(self):
+        """Connect + (re)register with the GCS.  Registration always carries
+        the node's FULL live state — hosted actors, PG bundles, local objects
+        — so a restarted GCS reconciles its restored tables against reality
+        (reference: ray_syncer resync + GcsInitData replay on GCS failover).
+
+        self.gcs is swapped only AFTER registration succeeds, and the close
+        callback is attached last: a half-initialized connection must neither
+        receive resource reports (a not-yet-registered node would be told
+        'unknown') nor spawn a second reconnect loop when it fails."""
+        # Full handler table: the GCS calls back over this same connection
+        # (lease_worker_for_actor, prepare/commit/cancel_bundle, ...).
+        gcs = await rpc.connect(*self.gcs_addr, handlers=self.handlers,
+                                name="nodelet->gcs")
+        resp = await gcs.call("register_node", {
+            "node_id": self.node_id.binary(),
+            "addr": list(self.addr),
+            "resources": self.resources_total,
+            "labels": self.labels,
+            "node_name": self.node_name,
+            "object_store_capacity": self.store.capacity,
+            "actors": [
+                {"actor_id": w.actor_id, "worker_addr": list(w.addr),
+                 "worker_id": w.worker_id}
+                for w in self.workers.values()
+                if w.is_actor and w.actor_id is not None and w.addr
+                and w.state != "dead"
+            ],
+            "bundles": [
+                {"pg_id": b.pg_id, "index": b.index, "resources": b.resources}
+                for b in self.bundles.values() if b.committed
+            ],
+            "objects": [oid.binary() for oid, e in self.store.objects.items()
+                        if e.sealed],
+        })
+        for view in resp["cluster_view"]:
+            self.cluster_view[view["node_id"]] = view
+        await gcs.call("subscribe", {"channel": "resource_view"})
+        await gcs.call("subscribe", {"channel": "node"})
+        old, self.gcs = self.gcs, gcs
+        if old is not None and old is not gcs and not old.closed:
+            await old.close()
+        gcs._on_close = self._on_gcs_lost
+
+    def _on_gcs_lost(self, conn):
+        if self._shutting_down or self._gcs_reconnecting:
+            return
+        self._gcs_reconnecting = True
+        logger.warning("nodelet %s lost the GCS connection; reconnecting",
+                       self.node_id.hex()[:8])
+        asyncio.get_event_loop().create_task(self._gcs_reconnect_loop())
+
+    async def _gcs_reconnect_loop(self):
+        """Retry the GCS with backoff (reference: raylets reconnect to a
+        restarted GCS when FT is on); give up and die after the window —
+        an isolated nodelet holding a TPU chip is worse than a dead one."""
+        deadline = time.monotonic() + RayConfig.gcs_reconnect_timeout_s
+        delay = 0.2
+        try:
+            while not self._shutting_down:
+                await asyncio.sleep(delay)
+                try:
+                    await self._connect_gcs()
+                    if self.gcs.closed:
+                        continue  # dropped in the attach window: retry
+                    logger.info("nodelet %s re-registered with the GCS",
+                                self.node_id.hex()[:8])
+                    return
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    if time.monotonic() > deadline:
+                        logger.error(
+                            "GCS unreachable for %.0fs; nodelet exiting",
+                            RayConfig.gcs_reconnect_timeout_s)
+                        os._exit(1)
+                    delay = min(delay * 1.5, 3.0)
+        finally:
+            self._gcs_reconnecting = False
 
     async def stop(self):
         self._shutting_down = True
@@ -214,6 +281,17 @@ class Nodelet:
                 if resp.get("dead"):
                     logger.error("GCS declared this node dead; exiting")
                     os._exit(1)
+                if resp.get("unknown") and not self._gcs_reconnecting:
+                    # A restarted GCS hasn't seen us: re-register in place.
+                    self._gcs_reconnecting = True
+                    try:
+                        await self._connect_gcs()
+                        logger.info("nodelet %s re-registered after GCS "
+                                    "restart", self.node_id.hex()[:8])
+                    except (ConnectionError, OSError, asyncio.TimeoutError):
+                        pass
+                    finally:
+                        self._gcs_reconnecting = False
             except (ConnectionError, asyncio.TimeoutError):
                 logger.warning("GCS unreachable from nodelet %s", self.node_id.hex()[:8])
 
@@ -767,6 +845,7 @@ class Nodelet:
         self._lease_seq += 1
         w.lease_id = self._lease_seq
         w.is_actor = True
+        w.actor_id = spec.actor_creation_id.binary() if spec.actor_creation_id else None
         self.leases[w.lease_id] = {"resources": spec.resources, "bundle": bundle, "worker": w}
         try:
             # No timeout: actor __init__ may legitimately take minutes (model
