@@ -48,6 +48,13 @@ class GPT2Config:
     # only cheap elementwise ops (gelu/layernorm/softmax) — near-zero extra
     # MXU FLOPs but longer live ranges (slower compile, more HBM).
     remat_policy: str = "full"  # "full" | "dots"
+    # MoE: every `moe_every`-th block swaps its dense MLP for an expert-
+    # parallel MoE FFN (0 = dense everywhere).  Experts shard over the `ep`
+    # mesh axis (models/moe.py).
+    moe_every: int = 0
+    n_experts: int = 8
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @staticmethod
     def small() -> "GPT2Config":
@@ -100,6 +107,7 @@ class MlpBlock(nn.Module):
 
 class Block(nn.Module):
     config: GPT2Config
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x, *, deterministic: bool = True):
@@ -107,8 +115,18 @@ class Block(nn.Module):
         x = x + Attention(cfg, name="attn")(
             nn.LayerNorm(dtype=cfg.dtype, name="ln_1")(x),
             deterministic=deterministic)
-        x = x + MlpBlock(cfg, name="mlp")(
-            nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x))
+        if self.use_moe:
+            from ray_tpu.models.moe import MoEConfig, MoEMlpBlock
+
+            moe_cfg = MoEConfig(
+                n_experts=cfg.n_experts, top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                d_model=cfg.n_embd, d_ff=4 * cfg.n_embd, dtype=cfg.dtype)
+            x = x + MoEMlpBlock(moe_cfg, name="moe_mlp")(
+                nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x))
+        else:
+            x = x + MlpBlock(cfg, name="mlp")(
+                nn.LayerNorm(dtype=cfg.dtype, name="ln_2")(x))
         return x
 
 
@@ -137,7 +155,10 @@ class GPT2LMModel(nn.Module):
         for i in range(cfg.n_layer):
             # remat each block: trade FLOPs for HBM (activations recomputed in
             # backward) — the standard TPU memory/bandwidth trade.
-            x = block_cls(cfg, name=f"h_{i}")(x, deterministic=deterministic)
+            use_moe = cfg.moe_every > 0 and (i % cfg.moe_every
+                                             == cfg.moe_every - 1)
+            x = block_cls(cfg, use_moe, name=f"h_{i}")(
+                x, deterministic=deterministic)
         x = nn.LayerNorm(dtype=cfg.dtype, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
                           name="lm_head")(x)

@@ -48,6 +48,13 @@ def init_params(config: GPT2Config, rng=None):
 
 
 def loss_fn(model: GPT2LMModel, params, batch):
+    if model.config.moe_every > 0:
+        from ray_tpu.models.moe import collect_moe_aux_loss
+
+        logits, state = model.apply({"params": params}, batch["input_ids"],
+                                    mutable=["intermediates"])
+        aux = collect_moe_aux_loss(state["intermediates"])
+        return lm_loss(logits, batch["targets"], batch.get("mask")) + aux
     logits = model.apply({"params": params}, batch["input_ids"])
     return lm_loss(logits, batch["targets"], batch.get("mask"))
 

@@ -13,7 +13,7 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
-AXES = ("dp", "fsdp", "tp", "sp", "ep")
+AXES = ("pp", "dp", "fsdp", "tp", "sp", "ep")
 
 
 @dataclass(frozen=True)
@@ -25,10 +25,11 @@ class MeshConfig:
     tp: int = 1
     sp: int = 1
     ep: int = 1
+    pp: int = 1
 
     def resolve(self, n_devices: int) -> Dict[str, int]:
-        sizes = {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp,
-                 "sp": self.sp, "ep": self.ep}
+        sizes = {"pp": self.pp, "dp": self.dp, "fsdp": self.fsdp,
+                 "tp": self.tp, "sp": self.sp, "ep": self.ep}
         wild = [k for k, v in sizes.items() if v == -1]
         if len(wild) > 1:
             raise ValueError(f"at most one axis may be -1, got {wild}")
@@ -63,7 +64,9 @@ def build_mesh(config: Optional[MeshConfig] = None, devices=None):
         devices = jax.devices()
     config = config or MeshConfig()
     sizes = config.resolve(len(devices))
-    order = ("dp", "fsdp", "sp", "tp", "ep")
+    # pp outermost: stage boundaries tolerate the slowest links (DCN between
+    # slices); tp/ep innermost for the tightest ICI neighborhoods.
+    order = ("pp", "dp", "fsdp", "sp", "tp", "ep")
     shape = tuple(sizes[a] for a in order)
     try:
         from jax.experimental import mesh_utils

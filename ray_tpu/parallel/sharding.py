@@ -57,6 +57,10 @@ def gpt_partition_rules() -> PartitionRules:
         (r"bias|scale|ln", _spec()),
         # lm head (embed, vocab)
         (r"lm_head/kernel", _spec("fsdp", "tp")),
+        # MoE experts: leading expert dim over ep (models/moe.py)
+        (r"router/kernel", _spec()),
+        (r"moe_mlp/w_in", _spec("ep", "fsdp", "tp")),
+        (r"moe_mlp/w_out", _spec("ep", "tp", "fsdp")),
     ])
 
 

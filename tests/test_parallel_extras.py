@@ -1,0 +1,113 @@
+"""MoE expert parallelism + GPipe pipeline over the virtual CPU mesh
+(greenfield TPU capabilities; SURVEY §2.3 rows EP and PP)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+
+
+def test_routing_dispatch_combine():
+    from ray_tpu.models.moe import compute_routing
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(2, 16, 4)), jnp.float32)
+    dispatch, combine, aux = compute_routing(logits, 4, 2, capacity=16)
+    # with ample capacity every token is dispatched to exactly top_k experts
+    per_token = dispatch.sum(axis=(2, 3))
+    np.testing.assert_allclose(per_token, 2.0, rtol=1e-6)
+    # combine weights are the gating probs: bounded by 1
+    assert float(combine.sum(axis=(2, 3)).max()) <= 1.0 + 1e-5
+    assert np.isfinite(float(aux))
+
+
+def test_moe_layer_forward_and_capacity():
+    from ray_tpu.models.moe import MoEConfig, MoEMlpBlock
+
+    cfg = MoEConfig(n_experts=4, top_k=1, capacity_factor=1.0,
+                    d_model=32, d_ff=64, dtype=jnp.float32)
+    layer = MoEMlpBlock(cfg)
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 8, 32)),
+                    jnp.float32)
+    variables = layer.init(jax.random.PRNGKey(0), x)
+    out, state = layer.apply(variables, x, mutable=["intermediates"])
+    assert out.shape == x.shape
+    assert np.isfinite(np.asarray(out)).all()
+    from ray_tpu.models.moe import collect_moe_aux_loss
+
+    aux = collect_moe_aux_loss(state["intermediates"])
+    assert np.isfinite(float(aux))
+
+
+def test_moe_gpt2_with_ep_sharding():
+    """GPT-2 with MoE blocks trains one step on an ep=2 mesh and the sharded
+    forward matches the single-device forward."""
+    from ray_tpu.models.gpt2 import GPT2Config, GPT2LMModel
+    from ray_tpu.parallel.sharding import (gpt_partition_rules,
+                                           match_partition_rules,
+                                           shard_pytree)
+
+    cfg = GPT2Config(vocab_size=128, n_positions=32, n_embd=32, n_layer=2,
+                     n_head=2, dtype=jnp.float32, attention_impl="reference",
+                     remat=False, moe_every=2, n_experts=4, moe_top_k=1)
+    model = GPT2LMModel(cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, 128, (4, 32)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), ids)["params"]
+    ref = model.apply({"params": params}, ids)
+
+    mesh = build_mesh(MeshConfig(dp=-1, ep=2), devices=jax.devices()[:4])
+    specs = match_partition_rules(gpt_partition_rules(), params)
+    with mesh:
+        sharded = shard_pytree(params, specs, mesh)
+        out = jax.jit(
+            lambda p, i: model.apply({"params": p}, i))(sharded, ids)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_matches_sequential():
+    from ray_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+
+    rng = np.random.default_rng(2)
+    S, M, B, D = 4, 6, 2, 8
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    stages = [{"w": jnp.asarray(rng.normal(size=(D, D)) * 0.5, jnp.float32),
+               "b": jnp.asarray(rng.normal(size=(D,)) * 0.1, jnp.float32)}
+              for _ in range(S)]
+    xs = jnp.asarray(rng.normal(size=(M, B, D)), jnp.float32)
+
+    # sequential reference
+    ref = []
+    for m in range(M):
+        h = xs[m]
+        for p in stages:
+            h = stage_fn(p, h)
+        ref.append(h)
+    ref = jnp.stack(ref)
+
+    mesh = build_mesh(MeshConfig(dp=1, pp=4), devices=jax.devices()[:4])
+    stacked = stack_stage_params(stages)
+    out = pipeline_apply(stage_fn, stacked, xs, mesh, axis="pp")
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_routing_no_slot_collisions_topk2():
+    """Regression: round-2 (2nd-choice) positions must not collide with
+    round-1 positions in the same expert queue — each (expert, slot) pair
+    holds at most ONE token."""
+    from ray_tpu.models.moe import compute_routing
+
+    rng = np.random.default_rng(3)
+    logits = jnp.asarray(rng.normal(size=(3, 32, 4)), jnp.float32)
+    dispatch, _, _ = compute_routing(logits, 4, 2, capacity=64)
+    per_slot = np.asarray(dispatch).sum(axis=1)  # (G, E, C)
+    assert per_slot.max() <= 1.0 + 1e-6, per_slot.max()
+    # and with ample capacity, nothing was dropped
+    assert float(dispatch.sum()) == 3 * 32 * 2

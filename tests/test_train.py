@@ -213,6 +213,6 @@ def test_jax_trainer_gpt2_sharded_through_actors(ray_start_regular, tmp_path):
     )
     result = trainer.fit()
     assert result.metrics["global_devices"] == 8
-    assert result.metrics["mesh"] == {"dp": 2, "fsdp": 2, "sp": 1, "tp": 2,
-                                      "ep": 1}
+    assert result.metrics["mesh"] == {"pp": 1, "dp": 2, "fsdp": 2, "sp": 1,
+                                      "tp": 2, "ep": 1}
     assert np.isfinite(result.metrics["loss"])
