@@ -197,6 +197,8 @@ class CoreWorker:
         # complete_task doesn't leak the pinned object forever.
         self._return_pins: deque = deque()
         self.io.spawn(self._sweep_return_pins_loop())
+        if mode == "worker":
+            self.io.spawn(self._push_metrics_loop())
 
     # ------------------------------------------------------- task events
     def emit_task_event(self, spec: TaskSpec, state: str,
@@ -226,6 +228,23 @@ class CoreWorker:
             # may be killed by it before the periodic tick, losing this task's
             # whole lifecycle from the state API.
             self.io.spawn(self._flush_task_events())
+
+    async def _push_metrics_loop(self):
+        """Push this worker's metrics (built-in + user-defined via
+        ray_tpu.util.metrics) to the nodelet's scrape endpoint (reference:
+        core worker -> per-node metrics agent)."""
+        from ray_tpu._private.metrics import default_registry
+
+        interval = RayConfig.metrics_report_interval_ms / 1000.0
+        source = f"worker-{self.worker_id.hex()[:12]}"
+        while not self._shut:
+            await asyncio.sleep(interval)
+            try:
+                await self.nodelet_conn.notify("metrics_push", {
+                    "source": source,
+                    "snapshot": default_registry.snapshot()})
+            except (ConnectionError, rpc.ConnectionLost):
+                pass
 
     async def _sweep_return_pins_loop(self):
         """Expire synthetic return-pins whose caller never claimed them (the
@@ -1379,7 +1398,13 @@ class NormalTaskSubmitter:
             msg = {"resources": spec.resources,
                    "strategy": {"kind": s.kind, "node_id": s.node_id, "soft": s.soft},
                    "bundle": bundle, "spillback_count": 0, "token": token}
-            for _ in range(8):  # bounded spillback chain
+            spill_hops = 0
+            while True:
+                if spill_hops >= 8:
+                    # pathological ping-pong: restart the chain from the
+                    # preferred target instead of silently dropping the task
+                    outcome = "retry"
+                    return
                 st["tokens"][token] = conn
                 resp = await conn.call("request_worker_lease", msg, timeout=None)
                 if resp["type"] == "cancelled":
@@ -1399,6 +1424,16 @@ class NormalTaskSubmitter:
                 if resp["type"] == "spillback":
                     conn = await self._nodelet_conn(tuple(resp["node_addr"]))
                     msg["spillback_count"] += 1
+                    spill_hops += 1
+                    continue
+                if resp["type"] == "retry":
+                    # No node fits TODAY: the demand is on the autoscaler's
+                    # desk; keep the task pending and re-evaluate the cluster
+                    # after a beat (reference: infeasible tasks stay queued —
+                    # a node type may yet be launched for them).
+                    await asyncio.sleep(resp.get("delay", 1.0))
+                    msg["spillback_count"] = 0
+                    conn = await self._lease_target(spec)
                     continue
                 # infeasible
                 err = RaySystemError(

@@ -44,7 +44,8 @@ logger = logging.getLogger(__name__)
 class NodeInfo:
     __slots__ = ("node_id", "addr", "resources_total", "resources_available",
                  "labels", "conn", "alive", "last_seen", "start_time", "node_name",
-                 "object_store_capacity", "death_cause")
+                 "object_store_capacity", "death_cause", "pending_demand",
+                 "metrics_addr")
 
     def __init__(self, node_id: NodeID, addr: Tuple[str, int], resources_total: Dict[str, float],
                  labels: Dict[str, str], conn: rpc.Connection, node_name: str = ""):
@@ -58,6 +59,8 @@ class NodeInfo:
         self.last_seen = time.monotonic()
         self.start_time = time.time()
         self.node_name = node_name
+        self.pending_demand = []  # queued lease resource shapes (autoscaler)
+        self.metrics_addr: Optional[Tuple[str, int]] = None  # /metrics scrape
         self.object_store_capacity = 0
         self.death_cause = ""
 
@@ -71,6 +74,7 @@ class NodeInfo:
             "alive": self.alive,
             "node_name": self.node_name,
             "start_time": self.start_time,
+            "metrics_addr": self.metrics_addr,
         }
 
 
@@ -147,7 +151,8 @@ class ActorInfo:
 
 
 class GcsServer:
-    def __init__(self, node_for_bundle=None):
+    def __init__(self, node_for_bundle=None, session_dir: Optional[str] = None):
+        self.session_dir = session_dir
         self.nodes: Dict[NodeID, NodeInfo] = {}
         self.actors: Dict[ActorID, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace, name)
@@ -156,6 +161,7 @@ class GcsServer:
         self.subscribers: Dict[str, Set[rpc.Connection]] = {}  # channel -> conns
         self.next_job = 1
         self.jobs: Dict[bytes, dict] = {}
+        self._submitted: Dict[str, dict] = {}  # submission_id -> {rec, proc}
         self.placement_groups: Dict[PlacementGroupID, Any] = {}  # filled by pg_manager
         self.task_events: deque = deque(maxlen=RayConfig.task_events_max_buffer_size)
         self.server = rpc.Server(self._handlers(), name="gcs")
@@ -354,6 +360,8 @@ class GcsServer:
             conn, node_name=msg.get("node_name", ""),
         )
         info.object_store_capacity = msg.get("object_store_capacity", 0)
+        ma = msg.get("metrics_addr")
+        info.metrics_addr = tuple(ma) if ma and ma[1] else None
         self.nodes[node_id] = info
         conn.context["node_id"] = node_id.binary()
         # Re-registration after a GCS restart (or a dropped connection): the
@@ -389,6 +397,7 @@ class GcsServer:
             return {"dead": True}
         info.last_seen = time.monotonic()
         info.resources_available = msg["available"]
+        info.pending_demand = msg.get("pending_demand", [])
         if msg.get("total"):
             info.resources_total = msg["total"]
         # Broadcast the delta so every nodelet's cluster view converges
@@ -399,6 +408,38 @@ class GcsServer:
             "total": info.resources_total,
         })
         return {"dead": False}
+
+    async def rpc_get_cluster_status(self, conn, msg):
+        """Aggregate load view for the autoscaler (reference: the GCS
+        autoscaler state service, autoscaler.proto:315 GetClusterStatus)."""
+        demand = []
+        for n in self.nodes.values():
+            if n.alive:
+                demand.extend(n.pending_demand)
+        # actors stuck pending for lack of resources are demand too
+        for a in self.actors.values():
+            if a.state == "PENDING_CREATION":
+                try:
+                    import pickle as _p
+
+                    spec = _p.loads(a.spec)
+                    if spec.resources:
+                        demand.append(dict(spec.resources))
+                except Exception:
+                    pass
+        return {
+            "nodes": [
+                {"node_id": n.node_id.binary(), "node_name": n.node_name,
+                 "alive": n.alive, "total": n.resources_total,
+                 "available": n.resources_available,
+                 "labels": n.labels, "start_time": n.start_time,
+                 "idle": all(
+                     n.resources_available.get(k, 0.0) >= v
+                     for k, v in n.resources_total.items())}
+                for n in self.nodes.values()
+            ],
+            "pending_demand": demand,
+        }
 
     async def rpc_get_cluster_view(self, conn, msg):
         return self.cluster_view()
@@ -434,6 +475,98 @@ class GcsServer:
         self._persist_job(rec)
         conn.context["job_id"] = job_id.binary()
         return {"job_id": job_id.binary()}
+
+    # ------------------------------------------------- submitted jobs
+    # Driver scripts submitted over RPC run as subprocesses of the head node
+    # (reference: JobManager, dashboard/modules/job/job_manager.py:58 — there
+    # a per-job supervisor actor; here the GCS supervises directly).
+
+    async def rpc_submit_job(self, conn, msg):
+        import os
+        import subprocess
+        import uuid
+
+        submission_id = msg.get("submission_id") or f"rtpu-job-{uuid.uuid4().hex[:10]}"
+        if submission_id in self._submitted:
+            raise ValueError(f"submission_id {submission_id!r} already used")
+        log_dir = os.path.join(self.session_dir or "/tmp/ray_tpu", "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(log_dir, f"job-{submission_id}.log")
+        env = dict(os.environ)
+        env["RAY_TPU_ADDRESS"] = f"{self.addr[0]}:{self.addr[1]}"
+        env.update((msg.get("runtime_env") or {}).get("env_vars") or {})
+        cwd = (msg.get("runtime_env") or {}).get("working_dir") or None
+        with open(log_path, "ab") as logf:
+            proc = subprocess.Popen(
+                msg["entrypoint"], shell=True, stdout=logf,
+                stderr=subprocess.STDOUT, env=env, cwd=cwd,
+                start_new_session=True)
+        rec = {
+            "job_id": b"",  # filled if/when the driver registers
+            "submission_id": submission_id,
+            "entrypoint": msg["entrypoint"],
+            "status": "RUNNING",
+            "start_time": time.time(),
+            "metadata": msg.get("metadata", {}),
+            "log_path": log_path,
+            "pid": proc.pid,
+        }
+        self._submitted[submission_id] = {"rec": rec, "proc": proc}
+        if not getattr(self, "_job_watcher_running", False):
+            self._job_watcher_running = True
+            asyncio.get_event_loop().create_task(self._watch_jobs_loop())
+        return {"submission_id": submission_id}
+
+    async def _watch_jobs_loop(self):
+        """One poller for ALL submitted jobs (a thread-per-job proc.wait
+        would exhaust the default executor past ~32 concurrent jobs)."""
+        while True:
+            running = [(sid, e) for sid, e in self._submitted.items()
+                       if e["rec"].get("end_time") is None]
+            if not running:
+                self._job_watcher_running = False
+                return
+            for sid, entry in running:
+                rc = entry["proc"].poll()
+                if rc is None:
+                    continue
+                if entry["rec"]["status"] != "STOPPED":  # user stop persists
+                    entry["rec"]["status"] = "SUCCEEDED" if rc == 0 else "FAILED"
+                entry["rec"]["end_time"] = time.time()
+                entry["rec"]["return_code"] = rc
+            await asyncio.sleep(0.5)
+
+    async def rpc_get_submitted_job(self, conn, msg):
+        entry = self._submitted.get(msg["submission_id"])
+        return dict(entry["rec"]) if entry else None
+
+    async def rpc_list_submitted_jobs(self, conn, msg):
+        return [dict(e["rec"]) for e in self._submitted.values()]
+
+    async def rpc_get_job_logs(self, conn, msg):
+        entry = self._submitted.get(msg["submission_id"])
+        if entry is None:
+            return None
+        try:
+            with open(entry["rec"]["log_path"], "rb") as f:
+                return f.read()[-int(msg.get("tail_bytes", 1 << 20)):]
+        except OSError:
+            return b""
+
+    async def rpc_stop_job(self, conn, msg):
+        import os
+        import signal
+
+        entry = self._submitted.get(msg["submission_id"])
+        if entry is None or entry["proc"].poll() is not None:
+            return False
+        try:
+            # the driver may have spawned children: signal the process group
+            os.killpg(os.getpgid(entry["proc"].pid), signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            entry["proc"].terminate()
+        entry["rec"]["status"] = "STOPPED"
+        return True
 
     async def rpc_mark_job_finished(self, conn, msg):
         j = self.jobs.get(msg["job_id"])
@@ -810,12 +943,13 @@ def main(argv=None):
     parser = argparse.ArgumentParser()
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--session-dir", default="")
     args = parser.parse_args(argv)
 
     logging.basicConfig(level=logging.INFO, format="[gcs] %(levelname)s %(message)s")
 
     async def run():
-        server = GcsServer()
+        server = GcsServer(session_dir=args.session_dir or None)
         host, port = await server.start(args.host, args.port)
         # Parent discovers the bound port from this line.
         print(f"GCS_PORT {port}", flush=True)

@@ -120,7 +120,8 @@ class Node:
         os.makedirs(logs, exist_ok=True)
         if self.head:
             self.gcs_proc, found = _spawn_and_scrape(
-                [sys.executable, "-u", "-m", "ray_tpu._private.gcs.server", "--port", "0"],
+                [sys.executable, "-u", "-m", "ray_tpu._private.gcs.server",
+                 "--port", "0", "--session-dir", self.session_dir],
                 {"GCS_PORT"}, os.path.join(logs, "gcs.log"), env=self._env(),
             )
             self.gcs_addr = ("127.0.0.1", int(found["GCS_PORT"]))
@@ -176,6 +177,6 @@ class Node:
         logs = os.path.join(self.session_dir, "logs")
         self.gcs_proc, _ = _spawn_and_scrape(
             [sys.executable, "-u", "-m", "ray_tpu._private.gcs.server",
-             "--port", str(self.gcs_addr[1])],
+             "--port", str(self.gcs_addr[1]), "--session-dir", self.session_dir],
             {"GCS_PORT"}, os.path.join(logs, "gcs.log"), env=self._env(),
         )

@@ -127,6 +127,10 @@ class Nodelet:
 
         self._dir_added: List[bytes] = []
         self._dir_removed: List[bytes] = []
+        # resource-shape -> (last_seen_ts, resources, last_warned_ts) of
+        # recently-rejected lease requests: reported (deduped per shape) as
+        # autoscaler demand until the submitter's retries land somewhere
+        self._infeasible_demand: Dict[tuple, tuple] = {}
 
         handlers = {}
         register_store_handlers(handlers, self.store, self.waiters, on_miss=self._on_store_miss)
@@ -145,6 +149,15 @@ class Nodelet:
     # ------------------------------------------------------------------ boot
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
         self.addr = await self.server.start(host, port)
+        # Prometheus scrape endpoint for this node's merged metrics
+        # (reference: the per-node metrics agent, _private/metrics_agent.py:483)
+        from ray_tpu._private.metrics import default_registry, serve_metrics_http
+
+        self.metrics_registry = default_registry
+        # bind the same interface as the RPC server: a loopback-bound scrape
+        # endpoint would be advertised cluster-wide yet unreachable remotely
+        self.metrics_addr = await serve_metrics_http(default_registry,
+                                                     host=self.addr[0] or host)
         await self._connect_gcs()
         if self.gcs.closed:  # dropped before _on_close was attached
             self._on_gcs_lost(self.gcs)
@@ -176,6 +189,7 @@ class Nodelet:
             "labels": self.labels,
             "node_name": self.node_name,
             "object_store_capacity": self.store.capacity,
+            "metrics_addr": list(getattr(self, "metrics_addr", ("", 0))),
             "actors": [
                 {"actor_id": w.actor_id, "worker_addr": list(w.addr),
                  "worker_id": w.worker_id}
@@ -273,10 +287,24 @@ class Nodelet:
         while True:
             await asyncio.sleep(interval)
             try:
+                # Pending demand: resource shapes of leases queued behind
+                # busy capacity — the autoscaler's scale-up signal
+                # (reference: ResourceLoad in the raylet's report).
+                demand = [dict(res) for res, _b, f in self._queued_leases
+                          if not f.done()]
+                cutoff = time.monotonic() - 5.0
+                for shape in list(self._infeasible_demand):
+                    ts, res, _w = self._infeasible_demand[shape]
+                    if ts < cutoff:
+                        del self._infeasible_demand[shape]
+                    else:
+                        demand.append(dict(res))
+                self._update_builtin_metrics()
                 resp = await self.gcs.call("resource_report", {
                     "node_id": self.node_id.binary(),
                     "available": self.resources_available,
                     "total": self.resources_total,
+                    "pending_demand": demand,
                 }, timeout=RayConfig.gcs_rpc_timeout_s)
                 if resp.get("dead"):
                     logger.error("GCS declared this node dead; exiting")
@@ -294,6 +322,42 @@ class Nodelet:
                         self._gcs_reconnecting = False
             except (ConnectionError, asyncio.TimeoutError):
                 logger.warning("GCS unreachable from nodelet %s", self.node_id.hex()[:8])
+
+    def _update_builtin_metrics(self):
+        """Node-level gauges (reference: metric_defs.cc canonical metrics)."""
+        from ray_tpu._private import metrics as M
+
+        if not hasattr(self, "_m_resources"):
+            self._m_resources = M.Gauge(
+                "node_resources_available", "available per resource")
+            self._m_resources_total = M.Gauge(
+                "node_resources_total", "total per resource")
+            self._m_workers = M.Gauge("node_workers", "worker processes")
+            self._m_store_bytes = M.Gauge(
+                "object_store_bytes_used", "plasma bytes in use")
+            self._m_store_objects = M.Gauge(
+                "object_store_objects", "local objects")
+        nid = self.node_id.hex()[:12]
+        for k, v in self.resources_available.items():
+            self._m_resources.set(v, {"node": nid, "resource": k})
+        for k, v in self.resources_total.items():
+            self._m_resources_total.set(v, {"node": nid, "resource": k})
+        self._m_workers.set(
+            sum(1 for w in self.workers.values() if w.state != "dead"),
+            {"node": nid})
+        st = self.store.stats()
+        self._m_store_bytes.set(st.get("used", 0), {"node": nid})
+        self._m_store_objects.set(st.get("num_objects", len(self.store.objects)),
+                                  {"node": nid})
+
+    async def rpc_metrics_push(self, conn, msg):
+        """A worker pushes its metric snapshot for this node's scrape
+        endpoint (reference: core-worker -> metrics agent export)."""
+        self.metrics_registry.merge_pushed(msg["source"], msg["snapshot"])
+        return True
+
+    async def rpc_get_metrics_text(self, conn, msg):
+        return self.metrics_registry.prometheus_text()
 
     async def _flush_dir_loop(self):
         while True:
@@ -742,8 +806,27 @@ class Nodelet:
             target = self._pick_node(resources, strategy)
             if target is None:
                 if not self._feasible_local(resources):
-                    return {"type": "infeasible",
-                            "reason": f"no node can ever satisfy {resources}"}
+                    # No node fits today — but the autoscaler may launch one:
+                    # record the unmet shape as demand (deduped: retries come
+                    # every second and must not look like N tasks) and have
+                    # the submitter retry, keeping the task pending
+                    # (reference: infeasible tasks wait; ResourceLoad drives
+                    # scale-up, with periodic infeasible-task warnings).
+                    now = time.monotonic()
+                    shape = tuple(sorted(resources.items()))
+                    prev = self._infeasible_demand.get(shape)
+                    warned = prev[2] if prev else 0.0
+                    if now - warned > 30.0:
+                        logger.warning(
+                            "task requiring %s cannot be scheduled on any "
+                            "current node; it stays pending (an autoscaler "
+                            "may add capacity)", resources)
+                        warned = now
+                    if len(self._infeasible_demand) < 256 or prev:
+                        self._infeasible_demand[shape] = (
+                            now, dict(resources), warned)
+                    return {"type": "retry", "delay": 1.0,
+                            "reason": f"no node currently satisfies {resources}"}
             elif target != self.node_id.binary():
                 view = self.cluster_view.get(target)
                 if view and view.get("addr"):

@@ -83,6 +83,10 @@ def init(
         from ray_tpu._private.core_worker import CoreWorker
         from ray_tpu._private.node import Node
 
+        if address is None:
+            # submitted-job drivers and `ray_tpu start` shells connect to the
+            # running cluster via the env (reference: RAY_ADDRESS)
+            address = os.environ.get("RAY_TPU_ADDRESS") or None
         node = None
         if address is None or address == "local":
             res = dict(resources or {})

@@ -1,0 +1,12 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+Reference: python/ray/autoscaler/ (StandardAutoscaler autoscaler.py:172,
+NodeProvider node_provider.py:13, fake multi-node provider for tests).
+"""
+
+from ray_tpu.autoscaler.autoscaler import (AutoscalingConfig, NodeTypeConfig,
+                                           StandardAutoscaler)
+from ray_tpu.autoscaler.node_provider import LocalNodeProvider, NodeProvider
+
+__all__ = ["AutoscalingConfig", "NodeTypeConfig", "StandardAutoscaler",
+           "NodeProvider", "LocalNodeProvider"]

@@ -1,0 +1,245 @@
+"""ray_tpu command-line interface.
+
+Reference: python/ray/scripts/scripts.py (`ray start` :571, `ray stop`,
+`ray status`) and the `ray job` CLI (dashboard/modules/job/cli.py), condensed
+to argparse (zero extra deps).  `start --head` launches a detached cluster
+whose address lands in both RAY_TPU_ADDRESS guidance and a well-known file so
+later shells (and `ray_tpu.init()` inside jobs) can find it.
+
+Usage:
+    python -m ray_tpu start --head [--num-cpus N] [--resources JSON]
+    python -m ray_tpu start --address HOST:PORT [--num-cpus N]
+    python -m ray_tpu status [--address HOST:PORT]
+    python -m ray_tpu stop
+    python -m ray_tpu job submit [--address A] -- CMD...
+    python -m ray_tpu job list/status/logs/stop [ID]
+    python -m ray_tpu timeline [--output PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_ADDR_FILE = os.path.join(
+    os.environ.get("RAY_TPU_TMPDIR", "/tmp/ray_tpu"), "current_cluster")
+
+
+def _resolve_address(explicit: str = None) -> str:
+    addr = explicit or os.environ.get("RAY_TPU_ADDRESS")
+    if addr:
+        return addr
+    try:
+        with open(_ADDR_FILE) as f:
+            rec = json.load(f)
+        return rec["address"]
+    except (OSError, ValueError, KeyError):
+        raise SystemExit(
+            "no running cluster found: pass --address, set RAY_TPU_ADDRESS, "
+            "or `ray_tpu start --head` first")
+
+
+def _cmd_start(args) -> int:
+    from ray_tpu._private.node import Node
+
+    resources = json.loads(args.resources) if args.resources else {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    if args.head:
+        node = Node(head=True, resources=resources or None,
+                    object_store_memory=args.object_store_memory)
+        node.start()
+        address = f"{node.gcs_addr[0]}:{node.gcs_addr[1]}"
+        os.makedirs(os.path.dirname(_ADDR_FILE), exist_ok=True)
+        with open(_ADDR_FILE, "w") as f:
+            json.dump({"address": address,
+                       "session_dir": node.session_dir,
+                       "pids": [p.pid for p in
+                                (node.gcs_proc, node.nodelet_proc) if p]},
+                      f)
+        print(f"ray_tpu head started at {address}")
+        print(f"  session dir: {node.session_dir}")
+        print(f"  connect with: ray_tpu.init(address=\"{address}\") or "
+              f"RAY_TPU_ADDRESS={address}")
+    else:
+        address = _resolve_address(args.address)
+        host, port = address.rsplit(":", 1)
+        node = Node(head=False, gcs_addr=(host, int(port)),
+                    resources=resources or None,
+                    object_store_memory=args.object_store_memory)
+        node.start()
+        # record the extra node's pids so `stop` reaps them too
+        try:
+            with open(_ADDR_FILE) as f:
+                rec = json.load(f)
+            rec.setdefault("pids", []).append(node.nodelet_proc.pid)
+            with open(_ADDR_FILE, "w") as f:
+                json.dump(rec, f)
+        except (OSError, ValueError):
+            pass
+        print(f"ray_tpu worker node joined {address}")
+    # Detach: the spawned daemons own their lifetime now.
+    return 0
+
+
+def _cmd_stop(args) -> int:
+    import signal
+
+    try:
+        with open(_ADDR_FILE) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        print("no recorded cluster; nothing to stop")
+        return 0
+    for pid in rec.get("pids", []):
+        try:
+            os.kill(pid, signal.SIGTERM)
+            print(f"stopped pid {pid}")
+        except ProcessLookupError:
+            pass
+    try:
+        os.remove(_ADDR_FILE)
+    except OSError:
+        pass
+    return 0
+
+
+def _gcs_call(address: str, method: str, msg=None):
+    from ray_tpu._private import rpc
+    from ray_tpu._private.rpc import EventLoopThread
+
+    host, port = address.rsplit(":", 1)
+    io = EventLoopThread(name="cli")
+    conn = io.run(rpc.connect(host, int(port), name="cli->gcs"))
+    try:
+        return conn.call_sync(method, msg, timeout=30)
+    finally:
+        try:
+            io.run(conn.close(), timeout=5)
+        except Exception:
+            pass
+        io.stop()
+
+
+def _cmd_status(args) -> int:
+    address = _resolve_address(args.address)
+    status = _gcs_call(address, "get_cluster_status")
+    print(f"cluster at {address}")
+    print(f"{'node':24} {'alive':6} {'resources (avail/total)'}")
+    for n in status["nodes"]:
+        res = ", ".join(
+            f"{k}: {n['available'].get(k, 0):g}/{v:g}"
+            for k, v in sorted(n["total"].items()))
+        print(f"{n['node_name']:24} {str(n['alive']):6} {res}")
+    demand = status.get("pending_demand", [])
+    if demand:
+        print(f"pending demand ({len(demand)} requests):")
+        from collections import Counter
+
+        shapes = Counter(json.dumps(d, sort_keys=True) for d in demand)
+        for shape, count in shapes.most_common():
+            print(f"  {count} x {shape}")
+    else:
+        print("no pending demand")
+    return 0
+
+
+def _cmd_timeline(args) -> int:
+    import ray_tpu
+    from ray_tpu.util import state
+
+    address = _resolve_address(args.address)
+    ray_tpu.init(address=address, ignore_reinit_error=True)
+    path = args.output or "ray-tpu-timeline.json"
+    events = state.timeline(path)
+    print(f"chrome://tracing timeline ({len(events)} events) written to {path}")
+    return 0
+
+
+def _cmd_job(args) -> int:
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    address = _resolve_address(args.address)
+    client = JobSubmissionClient(address)
+    try:
+        if args.job_cmd == "submit":
+            parts = list(args.entrypoint)
+            if parts and parts[0] == "--":  # argparse REMAINDER keeps the sep
+                parts = parts[1:]
+            entrypoint = " ".join(parts)
+            env = {"env_vars": dict(kv.split("=", 1) for kv in args.env)} \
+                if args.env else None
+            sid = client.submit_job(entrypoint=entrypoint, runtime_env=env,
+                                    submission_id=args.submission_id)
+            print(f"submitted job {sid}")
+            if args.wait:
+                status = client.wait_until_finished(sid, timeout=args.timeout)
+                print(client.get_job_logs(sid), end="")
+                print(f"job {sid}: {status}")
+                return 0 if status == "SUCCEEDED" else 1
+        elif args.job_cmd == "list":
+            for j in client.list_jobs():
+                print(f"{j.submission_id:28} {j.status:10} {j.entrypoint}")
+        elif args.job_cmd == "status":
+            print(client.get_job_status(args.submission_id))
+        elif args.job_cmd == "logs":
+            print(client.get_job_logs(args.submission_id), end="")
+        elif args.job_cmd == "stop":
+            ok = client.stop_job(args.submission_id)
+            print("stopped" if ok else "not running")
+        return 0
+    finally:
+        client.close()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head or worker node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--resources", default=None, help="JSON resource dict")
+    p.add_argument("--object-store-memory", type=int, default=None)
+    p.set_defaults(fn=_cmd_start)
+
+    p = sub.add_parser("stop", help="stop the recorded local cluster")
+    p.set_defaults(fn=_cmd_stop)
+
+    p = sub.add_parser("status", help="cluster nodes + pending demand")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=_cmd_status)
+
+    p = sub.add_parser("timeline", help="dump a chrome://tracing timeline")
+    p.add_argument("--address", default=None)
+    p.add_argument("--output", default=None)
+    p.set_defaults(fn=_cmd_timeline)
+
+    p = sub.add_parser("job", help="submit and manage jobs")
+    jsub = p.add_subparsers(dest="job_cmd", required=True)
+    ps = jsub.add_parser("submit")
+    ps.add_argument("--address", default=None)
+    ps.add_argument("--submission-id", default=None)
+    ps.add_argument("--env", action="append", default=[],
+                    help="KEY=VALUE runtime env var (repeatable)")
+    ps.add_argument("--wait", action="store_true")
+    ps.add_argument("--timeout", type=float, default=600.0)
+    ps.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    ps.set_defaults(fn=_cmd_job)
+    for name in ("list", "status", "logs", "stop"):
+        pj = jsub.add_parser(name)
+        pj.add_argument("--address", default=None)
+        if name != "list":
+            pj.add_argument("submission_id")
+        pj.set_defaults(fn=_cmd_job)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

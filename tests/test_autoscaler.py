@@ -1,0 +1,118 @@
+"""Autoscaler end-to-end: real demand -> real node launch -> idle reap
+(reference: the fake-multi-node autoscaler tests; here the provider launches
+REAL nodelet processes)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (AutoscalingConfig, LocalNodeProvider,
+                                NodeTypeConfig, StandardAutoscaler)
+
+
+@pytest.fixture
+def scaled_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)  # small head: forces scale-up quickly
+    ray_tpu.init(address=cluster.address)
+    cluster.wait_for_nodes()
+    provider = LocalNodeProvider(
+        {"gcs_addr": list(cluster.gcs_addr),
+         "session_dir": cluster.head_node.session_dir}, "test")
+    scaler = None
+    try:
+        yield cluster, provider, lambda s: s
+    finally:
+        ray_tpu.shutdown()
+        provider.shutdown()
+        cluster.shutdown()
+
+
+def _gcs_call(method, msg):
+    core = ray_tpu._private.worker.require_core()
+    return core.io.run(core.gcs_conn.call(method, msg))
+
+
+def test_scale_up_on_demand_then_reap(scaled_cluster):
+    cluster, provider, _ = scaled_cluster
+    config = AutoscalingConfig(
+        node_types={"cpu-worker": NodeTypeConfig(resources={"CPU": 2},
+                                                 max_workers=2)},
+        max_workers=2, idle_timeout_s=3.0, update_interval_s=0.5)
+    scaler = StandardAutoscaler(config, provider, _gcs_call)
+    scaler.start()
+    try:
+        @ray_tpu.remote(num_cpus=2)
+        def big():
+            import time as _t
+
+            _t.sleep(1.0)
+            return "done"
+
+        # head has 1 CPU: this task can only run on an autoscaled node
+        ref = big.remote()
+        assert ray_tpu.get(ref, timeout=120) == "done"
+        assert scaler.launched["cpu-worker"] >= 1
+        assert len(provider.non_terminated_nodes({})) >= 1
+
+        # after the work drains, the idle node is reaped (generous deadline:
+        # the suite shares one CPU core with the whole cluster)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if not provider.non_terminated_nodes({}):
+                break
+            time.sleep(0.5)
+        assert not provider.non_terminated_nodes({}), "idle node never reaped"
+        assert scaler.terminated >= 1
+    finally:
+        scaler.stop()
+
+
+def test_min_workers_and_binpack():
+    """Pure bin-packing logic (no cluster): demand packs onto the fewest
+    new nodes and respects max_workers."""
+    launched = []
+
+    class FakeProvider:
+        def __init__(self):
+            self.nodes = {}
+            self.n = 0
+
+        def non_terminated_nodes(self, tag_filters):
+            return [nid for nid, t in self.nodes.items()
+                    if all(t.get(k) == v for k, v in tag_filters.items())]
+
+        def node_tags(self, nid):
+            return self.nodes[nid]
+
+        def create_node(self, cfg, tags, count):
+            for _ in range(count):
+                self.n += 1
+                self.nodes[f"n{self.n}"] = dict(tags)
+                launched.append(cfg["resources"])
+
+        def terminate_node(self, nid):
+            self.nodes.pop(nid, None)
+
+        def is_running(self, nid):
+            return True
+
+        def node_name(self, nid):
+            return nid
+
+    provider = FakeProvider()
+    config = AutoscalingConfig(
+        node_types={"w": NodeTypeConfig(resources={"CPU": 4}, min_workers=1,
+                                        max_workers=3)},
+        max_workers=3)
+    status = {"nodes": [], "pending_demand": [{"CPU": 2}] * 6}
+    scaler = StandardAutoscaler(config, provider, lambda m, x: status)
+    scaler._ensure_min_workers()
+    assert len(provider.nodes) == 1
+    scaler.update()
+    # 6 x 2 CPU = 12 CPU -> 3 nodes of 4, capped at max_workers=3 (1 already up)
+    assert len(provider.nodes) == 3

@@ -1,0 +1,101 @@
+"""CLI (`python -m ray_tpu`) + job submission end-to-end (reference:
+`ray start/status/stop`, scripts.py:571; JobSubmissionClient, job sdk.py:35)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def detached_cluster(tmp_path):
+    """A cluster started via the CLI in a throwaway tmpdir."""
+    env = dict(os.environ)
+    env["RAY_TPU_TMPDIR"] = str(tmp_path)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    ray_tpu.shutdown()
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "start", "--head", "--num-cpus", "4"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert out.returncode == 0, out.stderr + out.stdout
+    rec = json.load(open(tmp_path / "current_cluster"))
+    try:
+        yield rec["address"], env
+    finally:
+        subprocess.run([sys.executable, "-m", "ray_tpu", "stop"],
+                       capture_output=True, text=True, env=env, timeout=60)
+        ray_tpu.shutdown()
+
+
+def test_cli_start_status_stop(detached_cluster):
+    address, env = detached_cluster
+    out = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "status"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "CPU: 4/4" in out.stdout
+
+    # a driver can connect to the CLI-started cluster
+    ray_tpu.init(address=address)
+    @ray_tpu.remote
+    def f():
+        return "via-cli"
+
+    assert ray_tpu.get(f.remote(), timeout=60) == "via-cli"
+    ray_tpu.shutdown()
+
+
+def test_job_submission_lifecycle(detached_cluster, tmp_path):
+    address, env = detached_cluster
+    from ray_tpu.job_submission import JobSubmissionClient, JobStatus
+
+    script = tmp_path / "job_script.py"
+    script.write_text(
+        "import ray_tpu\n"
+        "ray_tpu.init()\n"  # picks up RAY_TPU_ADDRESS from the job env
+        "@ray_tpu.remote\n"
+        "def sq(x):\n"
+        "    return x * x\n"
+        "print('RESULT', sum(ray_tpu.get([sq.remote(i) for i in range(5)])))\n"
+        "ray_tpu.shutdown()\n")
+
+    client = JobSubmissionClient(address)
+    try:
+        sid = client.submit_job(
+            entrypoint=f"{sys.executable} {script}",
+            runtime_env={"env_vars": {"PYTHONPATH": REPO}},
+            metadata={"owner": "test"})
+        status = client.wait_until_finished(sid, timeout=120)
+        logs = client.get_job_logs(sid)
+        assert status == JobStatus.SUCCEEDED, logs
+        assert "RESULT 30" in logs
+        infos = {j.submission_id: j for j in client.list_jobs()}
+        assert infos[sid].status == JobStatus.SUCCEEDED
+        assert infos[sid].metadata == {"owner": "test"}
+
+        # failing job reports FAILED with a nonzero return code
+        bad = client.submit_job(entrypoint=f"{sys.executable} -c 'exit(3)'")
+        assert client.wait_until_finished(bad, timeout=60) == JobStatus.FAILED
+        assert client.get_job_info(bad).return_code == 3
+
+        # long-running job can be stopped
+        slow = client.submit_job(
+            entrypoint=f"{sys.executable} -c 'import time; time.sleep(600)'")
+        time.sleep(1)
+        assert client.stop_job(slow)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if client.get_job_status(slow) in JobStatus.TERMINAL:
+                break
+            time.sleep(0.5)
+        assert client.get_job_status(slow) in (JobStatus.STOPPED,
+                                               JobStatus.FAILED)
+    finally:
+        client.close()
