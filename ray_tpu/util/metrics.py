@@ -1,0 +1,8 @@
+"""User-facing metrics API (reference: ray.util.metrics Counter/Gauge/
+Histogram).  Instances register in the process-local registry; workers push
+snapshots to their nodelet, whose HTTP /metrics endpoint Prometheus scrapes.
+"""
+
+from ray_tpu._private.metrics import Counter, Gauge, Histogram
+
+__all__ = ["Counter", "Gauge", "Histogram"]

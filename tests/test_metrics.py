@@ -1,0 +1,80 @@
+"""Metrics pipeline: registry, Prometheus text, HTTP scrape endpoint, and
+worker push (reference: stats/metric.h + metrics_agent.py + util.metrics)."""
+
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.metrics import Counter, Gauge, Histogram, Registry
+
+
+def test_registry_and_prometheus_text():
+    reg = Registry()
+    c = Counter("requests_total", "total requests", registry=reg)
+    g = Gauge("temperature", registry=reg)
+    h = Histogram("latency_s", boundaries=[0.1, 1.0], registry=reg)
+    c.inc(3, {"route": "/a"})
+    c.inc(1, {"route": "/b"})
+    g.set(42.5)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = reg.prometheus_text()
+    assert 'ray_tpu_requests_total{route="/a"} 3.0' in text
+    assert "# TYPE ray_tpu_requests_total counter" in text
+    assert "ray_tpu_temperature 42.5" in text
+    assert 'ray_tpu_latency_s_bucket{le="0.1"} 1.0' in text
+    assert 'ray_tpu_latency_s_bucket{le="+Inf"} 3.0' in text
+    assert "ray_tpu_latency_s_count 3.0" in text
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_node_scrape_endpoint_and_worker_push():
+    from conftest import ensure_shared_runtime
+
+    ensure_shared_runtime()
+
+    @ray_tpu.remote
+    def bump():
+        from ray_tpu.util.metrics import Counter
+
+        c = Counter("app_things_done", "things")
+        c.inc(5, {"kind": "test"})
+        import time as _t
+
+        _t.sleep(0.1)
+        return True
+
+    assert ray_tpu.get(bump.remote(), timeout=60)
+
+    # find the node's scrape endpoint from the cluster status
+    core = ray_tpu._private.worker.require_core()
+    status = core.io.run(core.gcs_conn.call("get_cluster_status", None))
+    # metrics addr travels via register_node; ask the nodelet directly
+    text = core.io.run(core.nodelet_conn.call("get_metrics_text", None))
+    assert "ray_tpu_node_resources_total" in text
+
+    # worker-pushed user metric shows up after a push interval
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        text = core.io.run(core.nodelet_conn.call("get_metrics_text", None))
+        if "app_things_done" in text:
+            break
+        time.sleep(0.5)
+    assert 'ray_tpu_app_things_done{kind="test",source="worker-' in text
+
+    # and over real HTTP, like Prometheus would scrape it
+    view = core.io.run(core.gcs_conn.call("get_cluster_view", None))
+    scraped = False
+    for n in view:
+        ma = n.get("metrics_addr")
+        if ma:
+            with urllib.request.urlopen(
+                    f"http://{ma[0]}:{ma[1]}/metrics", timeout=10) as resp:
+                body = resp.read().decode()
+            assert "ray_tpu_node_resources_total" in body
+            scraped = True
+    assert scraped, "no node exposed a metrics endpoint"
