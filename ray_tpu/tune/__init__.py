@@ -8,10 +8,14 @@ from ray_tpu.tune.search import (
     randint,
     uniform,
 )
+from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
+                                     PopulationBasedTraining)
+from ray_tpu.tune.session import get_checkpoint, report
 from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, Tuner
 
 __all__ = [
     "Tuner", "TuneConfig", "Trial", "ResultGrid",
     "grid_search", "choice", "uniform", "loguniform", "randint",
-    "run_trainer_as_single_trial",
+    "run_trainer_as_single_trial", "report", "get_checkpoint",
+    "FIFOScheduler", "ASHAScheduler", "PopulationBasedTraining",
 ]

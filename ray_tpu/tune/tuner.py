@@ -39,6 +39,7 @@ class TuneConfig:
     metric: Optional[str] = None
     mode: str = "max"
     trial_resources: Dict[str, float] = field(default_factory=lambda: {"CPU": 1.0})
+    scheduler: Optional[Any] = None  # FIFOScheduler | ASHAScheduler | PBT
 
     def __post_init__(self):
         if self.mode not in ("max", "min"):
@@ -103,12 +104,29 @@ class ResultGrid:
 
 
 def _run_function_trial(fn: Callable, config: Dict[str, Any],
-                        trial_dir: str) -> Dict[str, Any]:
-    """Task body for a function trainable: returns its final metrics dict."""
+                        trial_dir: str, coordinator=None,
+                        trial_index: int = -1,
+                        start_checkpoint=None) -> Dict[str, Any]:
+    """Task body for a function trainable: returns its final metrics dict.
+    Installs a tune session so ``tune.report`` streams intermediate metrics
+    to the controller and cooperative early-stop works (ASHA/PBT)."""
+    from ray_tpu.tune import session as tune_session
+
     os.makedirs(trial_dir, exist_ok=True)
-    out = fn(config)
+    sess = None
+    if coordinator is not None:
+        sess = tune_session._TuneSession(coordinator, trial_index)
+        sess.start_checkpoint = start_checkpoint
+        tune_session._set_session(sess)
+    try:
+        out = fn(config)
+    except tune_session.StopTrial:
+        out = dict(sess.last_metrics or {})
+        out["__early_stopped__"] = True
+    finally:
+        tune_session._set_session(None)
     if out is None:
-        out = {}
+        out = dict(sess.last_metrics or {}) if sess else {}
     if not isinstance(out, dict):
         raise TypeError(
             f"function trainable must return a metrics dict, got {type(out)}")
@@ -182,21 +200,76 @@ class Tuner:
         fn_task = ray_tpu.remote(_run_function_trial).options(**remote_opts)
         tr_task = ray_tpu.remote(_run_trainer_trial).options(**remote_opts)
 
-        def submit(trial: Trial):
+        # Scheduler + intermediate-result channel (reference: TuneController
+        # feeding its TrialScheduler; schedulers.py ASHA/PBT).
+        from ray_tpu.tune._trial_coordinator import TrialCoordinator
+        from ray_tpu.tune.schedulers import FIFOScheduler
+
+        scheduler = self._tune_config.scheduler or FIFOScheduler()
+        scheduler.set_experiment(self._tune_config.metric,
+                                 self._tune_config.mode)
+        # Plain FIFO needs no intermediate-result channel: skip the
+        # coordinator actor (and its 0.5s polling) entirely.
+        needs_coordinator = type(scheduler) is not FIFOScheduler \
+            and not is_trainer
+        coordinator = TrialCoordinator.remote() if needs_coordinator else None
+
+        def submit(trial: Trial, start_checkpoint=None):
             trial.status = "RUNNING"
+            if coordinator is not None:
+                ray_tpu.get(coordinator.clear_trial.remote(trial.index),
+                            timeout=60)
             if is_trainer:
                 return tr_task.remote(trainer_blob, trial.config, trial.name)
             return fn_task.remote(self._trainable, trial.config,
-                                  os.path.join(exp_dir, trial.name))
+                                  os.path.join(exp_dir, trial.name),
+                                  coordinator, trial.index, start_checkpoint)
+
+        by_index = {t.index: t for t in trials}
+
+        def pump_scheduler():
+            from ray_tpu.tune.schedulers import STOP, PopulationBasedTraining
+
+            if coordinator is None:
+                return
+            for ev in ray_tpu.get(coordinator.drain.remote(), timeout=60):
+                trial = by_index.get(ev["trial"])
+                if trial is None or trial.status != "RUNNING":
+                    continue
+                if ev.get("checkpoint") is not None and \
+                        isinstance(scheduler, PopulationBasedTraining):
+                    scheduler.record_checkpoint(trial.index, ev["checkpoint"])
+                if scheduler.on_result(trial, ev["metrics"]) == STOP:
+                    ray_tpu.get(coordinator.set_stop.remote(trial.index),
+                                timeout=60)
 
         pending = list(trials)
         running: Dict[Any, Trial] = {}
+        wait_timeout = 0.5 if coordinator is not None else None
+        try:
+            return self._drive(trials, pending, running, submit,
+                               pump_scheduler, scheduler, exp_dir, is_trainer,
+                               max_failures, wait_timeout)
+        finally:
+            if coordinator is not None:
+                try:
+                    ray_tpu.kill(coordinator)
+                except Exception:
+                    pass
+
+    def _drive(self, trials, pending, running, submit, pump_scheduler,
+               scheduler, exp_dir, is_trainer, max_failures, wait_timeout):
         while pending or running:
             while pending and len(running) < \
                     self._tune_config.max_concurrent_trials:
                 t = pending.pop(0)
-                running[submit(t)] = t
-            ready, _ = ray_tpu.wait(list(running), num_returns=1)
+                ckpt = t.config.pop("__pbt_checkpoint__", None)
+                running[submit(t, ckpt)] = t
+            ready, _ = ray_tpu.wait(list(running), num_returns=1,
+                                    timeout=wait_timeout)
+            pump_scheduler()
+            if not ready:
+                continue
             ref = ready[0]
             trial = running.pop(ref)
             try:
@@ -215,6 +288,14 @@ class Tuner:
                 self._snapshot(exp_dir, trials)
                 continue
             trial.status = "TERMINATED"
+            decision = scheduler.on_trial_complete(
+                trial, out if isinstance(out, dict) else None)
+            if decision is not None and decision[0] == "restart":
+                trial.config = decision[1]
+                trial.status = "PENDING"
+                pending.append(trial)
+                self._snapshot(exp_dir, trials)
+                continue
             if is_trainer:
                 from ray_tpu.train._checkpoint import Checkpoint
 

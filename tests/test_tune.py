@@ -125,3 +125,80 @@ def test_tuner_over_jax_trainer(ray_start_regular, tmp_path):
     assert best.metrics["config"]["lr"] == 3.0
     assert best.metrics["loss"] == 0.0
     assert len(best.metrics_history) == 2
+
+
+def test_asha_early_stops_bad_trials(ray_start_regular, tmp_path):
+    """ASHA cuts underperforming trials at rungs: bad trials run far fewer
+    iterations than good ones (reference: AsyncHyperBandScheduler)."""
+    from ray_tpu import tune
+
+    def trainable(config):
+        import time as _t
+
+        iters = 0
+        for i in range(16):
+            iters = i + 1
+            _t.sleep(0.25)  # give the controller a pump cycle per iteration
+            tune.report({"score": config["quality"] * (i + 1),
+                         "iters_done": iters})
+        return {"score": config["quality"] * 16, "iters_done": iters}
+
+    tuner = tune.Tuner(
+        trainable,
+        # strong trials FIRST: async successive halving cuts a trial at a
+        # rung only against results already recorded there
+        param_space={"quality": tune.grid_search([2.0, 1.0, 0.2, 0.1])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=2,
+            scheduler=tune.ASHAScheduler(max_t=16, grace_period=2,
+                                         reduction_factor=2)),
+        run_config=RunConfig(storage_path=str(tmp_path), name="asha"),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["config"]["quality"] == 2.0
+    # at least one weak trial was cut before finishing all 16 iterations
+    stopped_early = [r for r in grid
+                     if r.error is None and r.metrics.get("__early_stopped__")]
+    assert stopped_early, "ASHA never early-stopped a trial"
+
+
+def test_pbt_exploits_and_restarts(ray_start_regular, tmp_path):
+    """PBT stops a bottom-quantile trial and restarts it with a perturbed
+    top-quantile config plus the donor's checkpoint."""
+    from ray_tpu import tune
+
+    def trainable(config):
+        import time as _t
+
+        start = tune.get_checkpoint()
+        base = 100 if start == "warm" else 0
+        score = base
+        # the weak trial runs LONGER: even if the trials end up serialized,
+        # the weak one is still alive after the strong one's scores are
+        # recorded, so an exploit boundary always arrives
+        n = 48 if config["lr"] < 1 and base == 0 else 12
+        for i in range(n):
+            _t.sleep(0.2)
+            score = base + config["lr"] * (i + 1)
+            tune.report({"score": score}, checkpoint="warm")
+        return {"score": score, "lr": config["lr"], "warm": base > 0}
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.01, 10.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=2,
+            scheduler=tune.PopulationBasedTraining(
+                perturbation_interval=2, quantile_fraction=0.5,
+                hyperparam_mutations={"lr": [5.0, 10.0, 20.0]}, seed=0)),
+        run_config=RunConfig(storage_path=str(tmp_path), name="pbt"),
+    )
+    grid = tuner.fit()
+    results = [r for r in grid if r.error is None]
+    assert len(results) == 2
+    # the weak trial was exploited: restarted with a mutated strong lr and
+    # the donor's checkpoint (warm start)
+    warm = [r for r in results if r.metrics.get("warm")]
+    assert warm, "PBT never restarted a trial from a donor checkpoint"
+    assert all(r.metrics["lr"] >= 5.0 for r in warm)
