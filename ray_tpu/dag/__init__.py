@@ -1,0 +1,56 @@
+"""Task DAGs: lazy ``.bind()`` graphs executed over the task runtime.
+
+Reference: python/ray/dag/ (DAGNode, dag_node.py; FunctionNode bind API).
+``fn.bind(*args)`` builds the graph lazily; ``node.execute()`` submits every
+task with its upstream refs as arguments, so the runtime's normal dependency
+resolution drives execution order — no extra scheduler.  This is also the
+substrate the workflow layer persists (reference: workflows run DAGs with
+durable step results).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import ray_tpu
+
+
+class DAGNode:
+    """One lazy task invocation in a graph."""
+
+    def __init__(self, remote_fn, args: Tuple, kwargs: Dict[str, Any]):
+        self._remote_fn = remote_fn
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    # ------------------------------------------------------------- execute
+    def execute(self) -> Any:
+        """Submit the whole graph; returns the root's ObjectRef.  Shared
+        nodes (diamonds) submit once."""
+        return self._submit(memo={})
+
+    def _submit(self, memo: Dict[int, Any]):
+        key = id(self)
+        if key in memo:
+            return memo[key]
+        args = [a._submit(memo) if isinstance(a, DAGNode) else a
+                for a in self._bound_args]
+        kwargs = {k: (v._submit(memo) if isinstance(v, DAGNode) else v)
+                  for k, v in self._bound_kwargs.items()}
+        ref = self._remote_fn.remote(*args, **kwargs)
+        memo[key] = ref
+        return ref
+
+    # ----------------------------------------------------------- traversal
+    def upstream(self) -> List["DAGNode"]:
+        out = [a for a in self._bound_args if isinstance(a, DAGNode)]
+        out += [v for v in self._bound_kwargs.values()
+                if isinstance(v, DAGNode)]
+        return out
+
+    def fn_name(self) -> str:
+        fn = getattr(self._remote_fn, "_function", None)
+        return getattr(fn, "__name__", "task")
+
+    def __repr__(self):
+        return f"DAGNode({self.fn_name()})"

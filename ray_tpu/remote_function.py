@@ -37,6 +37,13 @@ class RemoteFunction:
         functools.update_wrapper(new, self._function)
         return new
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference: dag_node bind API); execute via
+        node.execute() or run durably via ray_tpu.workflow.run()."""
+        from ray_tpu.dag import DAGNode
+
+        return DAGNode(self, args, kwargs)
+
     def remote(self, *args, **kwargs):
         opts = self._default_options
         core = worker_mod.require_core()
