@@ -1,0 +1,168 @@
+"""Minimal cluster dashboard: REST JSON + a single-page HTML view.
+
+Reference: python/ray/dashboard/ (aiohttp head process + modules; React
+client).  Condensed to the load-bearing surface: one aiohttp app serving
+
+    GET /            — self-contained HTML overview (auto-refreshing)
+    GET /api/nodes   — node table (resources, liveness, metrics addr)
+    GET /api/actors  — actor table
+    GET /api/jobs    — submitted jobs
+    GET /api/cluster_status — autoscaler view (utilization + demand)
+    GET /api/tasks   — recent task events (state API passthrough)
+
+Start it with ``python -m ray_tpu.dashboard --address HOST:PORT`` or
+``ray_tpu.dashboard.run(address)``; it is a pure CLIENT of the GCS RPC port,
+so it can run anywhere that can reach the cluster.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Tuple
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>ray_tpu dashboard</title>
+<meta http-equiv="refresh" content="5">
+<style>
+ body { font-family: ui-monospace, monospace; margin: 2rem; }
+ table { border-collapse: collapse; margin-bottom: 1.5rem; }
+ th, td { border: 1px solid #ccc; padding: 4px 10px; text-align: left; }
+ th { background: #f0f0f0; }
+ h2 { margin-bottom: .3rem; }
+</style></head>
+<body>
+<h1>ray_tpu cluster</h1>
+<div id="content">loading…</div>
+<script>
+async function load() {
+  const [nodes, actors, jobs, status] = await Promise.all([
+    fetch('/api/nodes').then(r => r.json()),
+    fetch('/api/actors').then(r => r.json()),
+    fetch('/api/jobs').then(r => r.json()),
+    fetch('/api/cluster_status').then(r => r.json()),
+  ]);
+  let html = '<h2>Nodes</h2><table><tr><th>name</th><th>alive</th><th>resources</th></tr>';
+  for (const n of nodes) {
+    const res = Object.entries(n.total).map(
+      ([k, v]) => `${k}: ${n.available[k] ?? 0}/${v}`).join(', ');
+    html += `<tr><td>${n.node_name}</td><td>${n.alive}</td><td>${res}</td></tr>`;
+  }
+  html += '</table>';
+  html += `<h2>Pending demand</h2><p>${JSON.stringify(status.pending_demand)}</p>`;
+  html += '<h2>Actors</h2><table><tr><th>class</th><th>name</th><th>state</th><th>restarts</th></tr>';
+  for (const a of actors) {
+    html += `<tr><td>${a.class_name}</td><td>${a.name ?? ''}</td>` +
+            `<td>${a.state}</td><td>${a.num_restarts}</td></tr>`;
+  }
+  html += '</table>';
+  html += '<h2>Jobs</h2><table><tr><th>id</th><th>status</th><th>entrypoint</th></tr>';
+  for (const j of jobs) {
+    html += `<tr><td>${j.submission_id ?? j.job_id}</td><td>${j.status}</td>` +
+            `<td>${j.entrypoint ?? ''}</td></tr>`;
+  }
+  html += '</table>';
+  document.getElementById('content').innerHTML = html;
+}
+load();
+</script></body></html>
+"""
+
+
+class Dashboard:
+    def __init__(self, gcs_addr: Tuple[str, int]):
+        self.gcs_addr = gcs_addr
+        self._conn = None
+        self._io = None
+
+    def _call(self, method: str, msg=None):
+        from ray_tpu._private import rpc
+        from ray_tpu._private.rpc import EventLoopThread
+
+        if self._io is None:
+            self._io = EventLoopThread(name="dashboard-gcs")
+        if self._conn is None or self._conn.closed:
+            self._conn = self._io.run(
+                rpc.connect(*self.gcs_addr, name="dashboard->gcs"))
+        return self._conn.call_sync(method, msg, timeout=30)
+
+    # ------------------------------------------------------------ handlers
+    async def serve(self, host: str = "127.0.0.1", port: int = 8265) -> int:
+        import asyncio
+
+        from aiohttp import web
+
+        loop = asyncio.get_event_loop()
+
+        def offload(fn):
+            async def handler(request):
+                try:
+                    data = await loop.run_in_executor(None, fn)
+                except Exception as e:
+                    return web.json_response(
+                        {"error": f"{type(e).__name__}: {e}"}, status=500)
+                return web.json_response(data)
+            return handler
+
+        def nodes():
+            out = []
+            for n in self._call("get_all_node_info"):
+                n = dict(n)
+                n["node_id"] = n["node_id"].hex()
+                out.append(n)
+            return out
+
+        def actors():
+            out = []
+            for a in self._call("get_all_actor_info"):
+                a = dict(a)
+                for k in ("actor_id", "worker_id", "node_id", "job_id"):
+                    if a.get(k):
+                        a[k] = a[k].hex()
+                out.append(a)
+            return out
+
+        def jobs():
+            return (self._call("list_submitted_jobs")
+                    + [dict(j, job_id=j["job_id"].hex())
+                       for j in self._call("get_all_job_info")])
+
+        def cluster_status():
+            st = self._call("get_cluster_status")
+            for n in st["nodes"]:
+                n["node_id"] = n["node_id"].hex()
+            return st
+
+        def tasks():
+            return self._call("get_task_events", {"limit": 1000})
+
+        app = web.Application()
+        app.router.add_get("/", lambda r: web.Response(
+            text=_PAGE, content_type="text/html"))
+        app.router.add_get("/api/nodes", offload(nodes))
+        app.router.add_get("/api/actors", offload(actors))
+        app.router.add_get("/api/jobs", offload(jobs))
+        app.router.add_get("/api/cluster_status", offload(cluster_status))
+        app.router.add_get("/api/tasks", offload(tasks))
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, host, port)
+        await site.start()
+        for sock in site._server.sockets:  # type: ignore[union-attr]
+            return sock.getsockname()[1]
+        return port
+
+
+def run(address: str, *, host: str = "127.0.0.1",
+        port: int = 8265) -> None:
+    """Blocking entry point (reference: dashboard head process)."""
+    import asyncio
+
+    gcs_host, gcs_port = address.rsplit(":", 1)
+
+    async def main():
+        dash = Dashboard((gcs_host, int(gcs_port)))
+        bound = await dash.serve(host, port)
+        print(f"DASHBOARD_PORT {bound}", flush=True)
+        await asyncio.Event().wait()
+
+    asyncio.run(main())

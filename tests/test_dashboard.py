@@ -1,0 +1,73 @@
+"""Dashboard REST endpoints against a live cluster (reference:
+python/ray/dashboard head + api modules)."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster():
+    from conftest import ensure_shared_runtime
+
+    yield ensure_shared_runtime()
+
+
+def test_dashboard_endpoints(cluster):
+    import asyncio
+
+    from ray_tpu.dashboard import Dashboard
+
+    core = ray_tpu._private.worker.require_core()
+    dash = Dashboard(tuple(core._gcs_addr))
+
+    port_holder = {}
+    started = threading.Event()
+
+    def run_loop():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def main():
+            port_holder["port"] = await dash.serve(port=0)
+            started.set()
+            await asyncio.Event().wait()
+
+        try:
+            loop.run_until_complete(main())
+        except RuntimeError:
+            pass
+
+    t = threading.Thread(target=run_loop, daemon=True)
+    t.start()
+    assert started.wait(30)
+    port = port_holder["port"]
+
+    @ray_tpu.remote
+    class Marker:
+        def ping(self):
+            return 1
+
+    m = Marker.options(name="dash-marker").remote()
+    assert ray_tpu.get(m.ping.remote(), timeout=30) == 1
+
+    def get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            return json.loads(r.read())
+
+    nodes = get("/api/nodes")
+    assert nodes and any(n["alive"] for n in nodes)
+    actors = get("/api/actors")
+    assert any(a.get("name") == "dash-marker" for a in actors)
+    status = get("/api/cluster_status")
+    assert "pending_demand" in status
+    jobs = get("/api/jobs")
+    assert isinstance(jobs, list)
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=30) as r:
+        assert b"ray_tpu" in r.read()
+    ray_tpu.kill(m)
