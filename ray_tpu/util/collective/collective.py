@@ -6,10 +6,10 @@ Rendezvous rides the GCS KV (the reference uses a named store actor, reference:
 util/collective/util.py NCCLUniqueIDStore); data moves directly between member
 processes over the runtime RPC with pickle-5 zero-copy buffers.
 
-Topology: root-reduce for v1 (rank 0 reduces + broadcasts — fine for the small
-worlds this backend serves: host-side sync, CPU tests).  The bandwidth-optimal
-path for tensors is the ``xla`` backend over ICI; upgrading this one to a ring
-reduce-scatter is tracked for when eager host collectives get hot.
+Topology: ring (NCCL-style host rings) — allreduce is ring reduce-scatter +
+ring allgather (2(N-1) steps, ~2x payload per rank regardless of world size);
+reducescatter moves ~1x.  The bandwidth-optimal path for device tensors is
+still the ``xla`` backend over ICI; this backend covers host-side sync.
 """
 
 from __future__ import annotations
@@ -108,45 +108,99 @@ class Group:
             return data
 
     # ------------------------------------------------------------ primitives
+    # Ring topology (bandwidth-optimal, like NCCL's host rings): allreduce =
+    # ring reduce-scatter + ring allgather, 2(N-1) steps moving ~2x the
+    # payload total per rank regardless of world size — replaces the v1
+    # rank-0-root reduction whose root moved O(N) payloads.
+
+    def _reduce_op(self, acc, other, op: str):
+        if op in ("sum", "mean"):
+            return acc + other
+        if op == "max":
+            return np.maximum(acc, other)
+        if op == "min":
+            return np.minimum(acc, other)
+        raise ValueError(f"unsupported op {op!r}")
+
+    def _ring_reduce_scatter(self, chunks: List[np.ndarray], op: str,
+                             seq: int, shift: int = 0) -> List[np.ndarray]:
+        """After N-1 steps, chunk[(rank + 1 + shift) % N] holds the full
+        reduction (shift=-1 leaves rank r with shard r)."""
+        n = self.world_size
+        right = (self.rank + 1) % n
+        left = (self.rank - 1) % n
+        for step in range(n - 1):
+            send_idx = (self.rank - step + shift) % n
+            recv_idx = (self.rank - step - 1 + shift) % n
+            self._send_to(right, chunks[send_idx], seq, tag=step)
+            incoming = np.asarray(self._recv_from(left, seq, tag=step))
+            chunks[recv_idx] = self._reduce_op(chunks[recv_idx], incoming, op)
+        return chunks
+
+    def _ring_allgather_chunks(self, chunks: List[np.ndarray], owned_idx: int,
+                               seq: int, tag_base: int) -> List[np.ndarray]:
+        """Each rank starts holding chunk[owned_idx]; N-1 rotations fill all."""
+        n = self.world_size
+        right = (self.rank + 1) % n
+        left = (self.rank - 1) % n
+        for step in range(n - 1):
+            send_idx = (owned_idx - step) % n
+            recv_idx = (owned_idx - step - 1) % n
+            self._send_to(right, chunks[send_idx], seq, tag=tag_base + step)
+            chunks[recv_idx] = np.asarray(
+                self._recv_from(left, seq, tag=tag_base + step))
+        return chunks
+
     def allreduce(self, array, op: str = "sum"):
         seq = self._next_seq()
         arr = np.asarray(array)
-        if self.rank == 0:
-            acc = arr.astype(np.float64 if op in ("sum", "mean") else arr.dtype)
-            for r in range(1, self.world_size):
-                other = np.asarray(self._recv_from(r, seq))
-                if op in ("sum", "mean"):
-                    acc = acc + other
-                elif op == "max":
-                    acc = np.maximum(acc, other)
-                elif op == "min":
-                    acc = np.minimum(acc, other)
-                else:
-                    raise ValueError(f"unsupported op {op!r}")
-            if op == "mean":
-                acc = acc / self.world_size
-            result = acc.astype(arr.dtype)
-            for r in range(1, self.world_size):
-                self._send_to(r, result, seq, tag=1)
-            return result
-        self._send_to(0, arr, seq)
-        return np.asarray(self._recv_from(0, seq, tag=1))
+        n = self.world_size
+        if n == 1:
+            return arr.copy()  # incl. mean: averaging one rank is identity
+        acc_dtype = np.float64 if op in ("sum", "mean") else arr.dtype
+        flat = arr.astype(acc_dtype).ravel()
+        chunks = [c.copy() for c in np.array_split(flat, n)]
+        chunks = self._ring_reduce_scatter(chunks, op, seq)
+        owned = (self.rank + 1) % n
+        chunks = self._ring_allgather_chunks(chunks, owned, seq,
+                                             tag_base=1000)
+        out = np.concatenate([np.asarray(c, dtype=acc_dtype).ravel()
+                              for c in chunks])
+        if op == "mean":
+            out = out / n
+        return out.astype(arr.dtype).reshape(arr.shape)
 
     def allgather(self, array) -> List[np.ndarray]:
         seq = self._next_seq()
         arr = np.asarray(array)
-        if self.rank == 0:
-            parts = [arr] + [np.asarray(self._recv_from(r, seq))
-                             for r in range(1, self.world_size)]
-            for r in range(1, self.world_size):
-                self._send_to(r, parts, seq, tag=1)
-            return parts
-        self._send_to(0, arr, seq)
-        return [np.asarray(a) for a in self._recv_from(0, seq, tag=1)]
+        n = self.world_size
+        if n == 1:
+            return [arr.copy()]
+        # per-rank payloads may differ in shape: rotate whole arrays
+        chunks: List[Any] = [None] * n
+        chunks[self.rank] = arr
+        chunks = self._ring_allgather_chunks(chunks, self.rank, seq,
+                                             tag_base=0)
+        return [np.asarray(c) for c in chunks]
 
     def reducescatter(self, array, op: str = "sum"):
-        full = self.allreduce(array, op)
-        return np.array_split(full, self.world_size)[self.rank]
+        """True ring reduce-scatter: each rank moves ~1x the payload and
+        returns only its shard (v1 was allreduce-then-split: no saving)."""
+        seq = self._next_seq()
+        arr = np.asarray(array)
+        n = self.world_size
+        if n == 1:
+            return arr.copy()
+        acc_dtype = np.float64 if op in ("sum", "mean") else arr.dtype
+        # split along axis 0, exactly like v1's array_split(allreduce(x), n):
+        # a (4, 4) input with n=2 yields (2, 4) shards, not flat slices
+        chunks = [c.copy() for c in
+                  np.array_split(arr.astype(acc_dtype), n, axis=0)]
+        chunks = self._ring_reduce_scatter(chunks, op, seq, shift=-1)
+        mine = chunks[self.rank]
+        if op == "mean":
+            mine = mine / n
+        return np.asarray(mine).astype(arr.dtype)
 
     def broadcast(self, array, root: int = 0):
         seq = self._next_seq()

@@ -195,3 +195,13 @@ class TestXlaLowering:
         out = self._run(lambda s: xla.alltoall(s, "dp"), x)
         expect = np.arange(16, dtype=np.float32).reshape(4, 4).T.reshape(-1)
         np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def test_reducescatter_2d_shape_parity(members):
+    # shard shapes must match v1's array_split(allreduce(x), n, axis=0)
+    data = np.arange(float(WORLD * 2 * 3)).reshape(WORLD * 2, 3)
+    outs = ray_tpu.get([a.reducescatter.remote(data) for a in members])
+    full = data * WORLD
+    for r, o in enumerate(outs):
+        np.testing.assert_allclose(o, np.array_split(full, WORLD, axis=0)[r])
+        assert o.shape == (2, 3)
