@@ -121,6 +121,10 @@ _d("actor_max_restarts_default", int, 0, "default actor restarts")
 _d("lineage_enabled", bool, True, "enable lineage-based object recovery")
 _d("max_lineage_bytes", int, 256 * 1024**2, "lineage retention budget per owner")
 
+# --- Memory monitor ---
+_d("memory_monitor_refresh_ms", int, 1000, "node memory pressure check period; 0 disables")
+_d("memory_usage_threshold", float, 0.95, "kill a retriable worker above this node memory fraction")
+
 # --- Metrics / events ---
 _d("event_stats", bool, True, "record per-handler event-loop stats")
 _d("metrics_report_interval_ms", int, 5_000, "metrics push period")

@@ -47,7 +47,8 @@ class _LeaseCancelled(Exception):
 
 class WorkerHandle:
     __slots__ = ("worker_id", "proc", "conn", "addr", "pid", "state", "lease_id",
-                 "is_actor", "actor_id", "started_at", "idle_since")
+                 "is_actor", "actor_id", "started_at", "idle_since",
+                 "leased_since")
 
     def __init__(self, worker_id: bytes, proc: Optional[subprocess.Popen]):
         self.worker_id = worker_id
@@ -61,6 +62,7 @@ class WorkerHandle:
         self.actor_id: Optional[bytes] = None  # hosting this actor (re-reported on GCS reconnect)
         self.started_at = time.monotonic()
         self.idle_since = time.monotonic()
+        self.leased_since = 0.0  # stamped when state flips to "leased"
 
 
 class Bundle:
@@ -561,6 +563,7 @@ class Nodelet:
                 continue
             w = idle[0]
             w.state = "leased"
+            w.leased_since = time.monotonic()
             fut.set_result(w)
         # Maintain pipeline: spawn if LIVE demand outstrips starting workers —
         # cancelled pops (done futures) must not trigger spawns, or a drained
@@ -576,6 +579,7 @@ class Nodelet:
         if idle:
             w = idle[0]
             w.state = "leased"
+            w.leased_since = time.monotonic()
             return w
         fut = asyncio.get_event_loop().create_future()
         self._pop_queue.append(fut)
@@ -620,6 +624,11 @@ class Nodelet:
                                                 report=False)
 
     async def _monitor_workers_loop(self):
+        from ray_tpu._private.memory_monitor import MemoryMonitor
+
+        mm = MemoryMonitor(RayConfig.memory_usage_threshold) \
+            if RayConfig.memory_monitor_refresh_ms > 0 else None
+        last_mm_check = 0.0
         while True:
             await asyncio.sleep(0.2)
             for w in list(self.workers.values()):
@@ -632,6 +641,47 @@ class Nodelet:
                 if w.state == "idle" and now - w.idle_since > reap_after:
                     self._kill_worker_proc(w)
                     await self._handle_worker_death(w, "idle reaped", report=False)
+            # Memory pressure: kill the cheapest-to-retry worker before the
+            # kernel OOM-killer shoots something load-bearing (reference:
+            # MemoryMonitor + retriable-FIFO worker killing policy).
+            if mm is not None and \
+                    now - last_mm_check > RayConfig.memory_monitor_refresh_ms / 1000.0:
+                last_mm_check = now
+                if mm.is_pressured():
+                    victim = self._pick_oom_victim()
+                    if victim is not None:
+                        frac = mm.usage_fraction()
+                        logger.warning(
+                            "node memory at %.0f%% (threshold %.0f%%): "
+                            "killing worker %s to relieve pressure",
+                            (frac or 0) * 100,
+                            RayConfig.memory_usage_threshold * 100,
+                            victim.worker_id.hex()[:8])
+                        self._kill_worker_proc(victim)
+                        await self._handle_worker_death(
+                            victim, "killed by the memory monitor: node "
+                            "memory usage above threshold")
+
+    def _pick_oom_victim(self):
+        """Idle workers first (zero work lost), then the task worker with
+        the NEWEST lease (least progress lost), actors only as a last resort
+        — their state dies with them (reference:
+        worker_killing_policy_group_by_owner / _retriable_fifo, approximated:
+        the nodelet never sees the task spec, so per-task retriability is
+        unknown here — the submitter's retry budget decides what happens
+        next)."""
+        idle = [w for w in self.workers.values() if w.state == "idle"]
+        if idle:
+            return idle[0]
+        leased = [w for w in self.workers.values()
+                  if w.state == "leased" and not w.is_actor]
+        if leased:
+            return max(leased, key=lambda w: w.leased_since)
+        actors = [w for w in self.workers.values()
+                  if w.is_actor and w.state != "dead"]
+        if actors:
+            return max(actors, key=lambda w: w.started_at)
+        return None
 
     async def _handle_worker_death(self, w: WorkerHandle, reason: str, report: bool = True):
         if w.state == "dead":

@@ -1,0 +1,61 @@
+"""Memory monitor: OOM-pressure worker killing (reference:
+common/memory_monitor.h + raylet worker_killing_policy_retriable_fifo)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.memory_monitor import MemoryMonitor
+
+
+def test_usage_detection_real():
+    mm = MemoryMonitor(threshold=0.95)
+    frac = mm.usage_fraction()
+    assert frac is not None and 0.0 < frac < 1.0
+
+
+def test_fake_usage_env(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_FAKE_MEMORY_USAGE", "0.99")
+    assert MemoryMonitor(0.95).is_pressured()
+    monkeypatch.setenv("RAY_TPU_FAKE_MEMORY_USAGE", "0.10")
+    assert not MemoryMonitor(0.95).is_pressured()
+
+
+def test_pressure_kills_retriable_worker_and_task_retries(monkeypatch):
+    """Under (faked) memory pressure the nodelet kills the task's worker;
+    the task retries and succeeds once pressure clears."""
+    import time
+
+    flag = "/tmp/rtpu_mm_pressure_flag"
+    try:
+        os.unlink(flag)
+    except OSError:
+        pass
+    # the env var propagates to the cluster subprocesses
+    monkeypatch.setenv("RAY_TPU_FAKE_MEMORY_USAGE_FILE", flag)
+    monkeypatch.setenv("RAY_TPU_FAKE_MEMORY_USAGE", "")  # file-driven
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, object_store_memory=128 * 1024 * 1024)
+    try:
+        @ray_tpu.remote(max_retries=2)
+        def slow():
+            import time as _t
+
+            _t.sleep(4.0)
+            return os.getpid()
+
+        # raise the pressure flag AFTER the task starts
+        ref = slow.remote()
+        time.sleep(1.0)
+        open(flag, "w").write("0.99")
+        time.sleep(2.5)  # monitor tick kills the worker mid-task
+        os.unlink(flag)  # pressure clears; retry succeeds
+        pid = ray_tpu.get(ref, timeout=120)
+        assert isinstance(pid, int)
+    finally:
+        ray_tpu.shutdown()
+        try:
+            os.unlink(flag)
+        except OSError:
+            pass
