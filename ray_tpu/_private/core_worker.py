@@ -573,27 +573,80 @@ class CoreWorker:
 
     def wait(self, refs: List[ObjectRef], num_returns: int, timeout: Optional[float],
              fetch_local: bool = True) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        """Event-driven wait (reference: raylet/wait_manager.h — the v1 poll
+        loop issued one sync RPC per borrowed ref per tick).
+
+        Owned refs arm memory-store ready callbacks; borrowed refs issue ONE
+        long-poll RPC each to their owner (wait_object blocks server-side).
+        The caller thread then sleeps on a single Event instead of polling;
+        only owned-but-unknown refs (post-restart plasma residents) still
+        need a slow poll, and only those."""
         deadline = None if timeout is None else time.monotonic() + timeout
         pending = list(refs)
         ready: List[ObjectRef] = []
-        poll = RayConfig.wait_poll_interval_ms / 1000.0
-        while len(ready) < num_returns:
-            still = []
-            for r in pending:
-                if self._is_ready(r):
-                    ready.append(r)
-                    if len(ready) >= num_returns:
-                        still.extend(pending[pending.index(r) + 1:])
-                        break
-                else:
-                    still.append(r)
-            pending = still
+        done_event = threading.Event()
+        ready_oids: Set[bytes] = set()
+        ready_lock = threading.Lock()
+
+        def mark(oid_bin: bytes):
+            with ready_lock:
+                ready_oids.add(oid_bin)
+            done_event.set()
+
+        slow_poll: List[ObjectRef] = []
+        for r in pending:
+            oid = r.oid
+            if self.memory_store.known(oid):
+                if self.memory_store.add_ready_callback(
+                        oid, lambda b=oid.binary(): mark(b)):
+                    mark(oid.binary())
+                continue
+            owner_addr = r.owner_addr()
+            if owner_addr is None or owner_addr == self.addr:
+                slow_poll.append(r)  # plasma-resident: no event source
+                continue
+            self.io.spawn(self._wait_borrowed(r, deadline, mark))
+
+        while True:
+            with ready_lock:
+                snapshot = set(ready_oids)
+            ready = [r for r in pending if r.oid.binary() in snapshot]
             if len(ready) >= num_returns:
+                ready = ready[:num_returns]
                 break
-            if deadline is not None and time.monotonic() >= deadline:
+            for r in slow_poll:
+                if r.oid.binary() not in snapshot and self.plasma.contains(r.oid):
+                    mark(r.oid.binary())
+            rem = None if deadline is None else deadline - time.monotonic()
+            if rem is not None and rem <= 0:
                 break
-            time.sleep(poll)
-        return ready, pending
+            done_event.clear()
+            step = RayConfig.wait_poll_interval_ms / 1000.0 if slow_poll \
+                else 5.0
+            done_event.wait(step if rem is None else min(step, rem))
+        ready_set = {id(r) for r in ready}
+        return ready, [r for r in pending if id(r) not in ready_set]
+
+    async def _wait_borrowed(self, ref: ObjectRef, deadline, mark):
+        """One long-poll to the owner per borrowed ref (owner blocks until
+        the object is ready or the timeout lapses)."""
+        while True:
+            rem = None if deadline is None else deadline - time.monotonic()
+            if rem is not None and rem <= 0:
+                return
+            chunk = 10.0 if rem is None else min(10.0, rem)
+            try:
+                conn = await self._owner_conn_async(tuple(ref.owner_addr()))
+                resp = await conn.call(
+                    "wait_object", {"oid": ref.oid.binary(), "timeout": chunk},
+                    timeout=chunk + RayConfig.gcs_rpc_timeout_s)
+            except (ConnectionError, OSError, rpc.ConnectionLost,
+                    asyncio.TimeoutError):
+                mark(ref.oid.binary())  # owner died: get() raises quickly
+                return
+            if resp.get("ready"):
+                mark(ref.oid.binary())
+                return
 
     def _is_ready(self, ref: ObjectRef) -> bool:
         oid = ref.oid
@@ -705,6 +758,31 @@ class CoreWorker:
     async def rpc_object_status(self, conn, msg):
         oid = ObjectID(msg["oid"])
         return {"ready": self.memory_store.contains(oid)}
+
+    async def rpc_wait_object(self, conn, msg):
+        """Long-poll: block until an owned object is ready (or timeout) so
+        borrowers' wait() needs one RPC per ref, not one per poll tick
+        (reference: WaitManager event-driven waits)."""
+        oid = ObjectID(msg["oid"])
+        timeout = msg.get("timeout", 10.0)
+        if self.memory_store.contains(oid):
+            return {"ready": True}
+        if not self.memory_store.known(oid):
+            return {"ready": True}  # freed/unknown: let get() surface it
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        cb = lambda: loop.call_soon_threadsafe(  # noqa: E731
+            lambda: fut.done() or fut.set_result(True))
+        if self.memory_store.add_ready_callback(oid, cb):
+            return {"ready": True}
+        try:
+            await asyncio.wait_for(fut, timeout)
+            return {"ready": True}
+        except asyncio.TimeoutError:
+            # deregister, or every long-poll round leaks a closure on a
+            # long-pending object
+            self.memory_store.remove_ready_callback(oid, cb)
+            return {"ready": False}
 
     async def rpc_ref_borrow(self, conn, msg):
         oid = ObjectID(msg["oid"])

@@ -100,6 +100,19 @@ class MemoryStore:
                 self._entries.setdefault(oid, _Entry())
         return False
 
+    def remove_ready_callback(self, oid: ObjectID, cb) -> None:
+        """Deregister a callback added by add_ready_callback (long-poll
+        timeouts must not accumulate closures on long-pending objects)."""
+        with self._lock:
+            lst = self._waiter_cbs.get(oid)
+            if lst is not None:
+                try:
+                    lst.remove(cb)
+                except ValueError:
+                    pass
+                if not lst:
+                    del self._waiter_cbs[oid]
+
     def delete(self, oid: ObjectID) -> None:
         with self._lock:
             self._entries.pop(oid, None)
