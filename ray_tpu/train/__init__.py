@@ -14,6 +14,7 @@ from ray_tpu.air.config import (
 from ray_tpu.air.result import Result
 from ray_tpu.train._checkpoint import Checkpoint
 from ray_tpu.train._session import (
+    get_dataset_shard,
     TrainContext,
     get_checkpoint,
     get_context,
@@ -28,7 +29,7 @@ __all__ = [
     "BaseTrainer", "DataParallelTrainer", "JaxTrainer",
     "BackendConfig", "JaxConfig",
     "Checkpoint", "TrainContext", "TrainingFailedError",
-    "report", "get_checkpoint", "get_context",
+    "report", "get_checkpoint", "get_context", "get_dataset_shard",
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
     "Result",
 ]

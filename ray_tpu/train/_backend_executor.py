@@ -57,7 +57,8 @@ class BackendExecutor:
     def start_training(self, train_fn, train_loop_config: Dict[str, Any],
                        experiment_name: str, trial_name: str, trial_dir: str,
                        checkpoint_path: Optional[str] = None,
-                       checkpoint_seq_start: int = 0) -> None:
+                       checkpoint_seq_start: int = 0,
+                       dataset_shards: Optional[list] = None) -> None:
         assert self.worker_group is not None, "call start() first"
         wg = self.worker_group
         self._backend.on_training_start(wg, self._backend_config)
@@ -83,8 +84,10 @@ class BackendExecutor:
             ))
         ray_tpu.get([
             w.session_start.remote(train_fn, train_loop_config, ctx,
-                                   checkpoint_path, checkpoint_seq_start)
-            for w, ctx in zip(wg.workers, contexts)
+                                   checkpoint_path, checkpoint_seq_start,
+                                   dataset_shards[rank] if dataset_shards
+                                   else None)
+            for rank, (w, ctx) in enumerate(zip(wg.workers, contexts))
         ])
 
     # ------------------------------------------------------------ results

@@ -76,9 +76,11 @@ class _TrainingResult:
 class _TrainSession:
     def __init__(self, train_fn, config: Dict[str, Any], context: TrainContext,
                  starting_checkpoint: Optional[str] = None,
-                 checkpoint_seq_start: int = 0):
+                 checkpoint_seq_start: int = 0,
+                 dataset_shards: Optional[Dict[str, Any]] = None):
         self.context = context
         self.starting_checkpoint = starting_checkpoint
+        self.dataset_shards = dataset_shards or {}
         self._result_q: "queue.Queue[_TrainingResult]" = queue.Queue(maxsize=1)
         self._consumed = threading.Semaphore(0)
         # Continue numbering after any earlier attempt's checkpoints (passed
@@ -180,6 +182,22 @@ def report(metrics: Dict[str, Any],
         print(f"[train.report] {metrics}")
         return
     s.report(metrics, checkpoint)
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's split of a Dataset passed to the trainer as
+    ``datasets={name: ds}`` (reference: ray.train.get_dataset_shard) — a
+    DataIterator whose iter_batches/iter_jax_batches pull from the shared
+    streaming executor."""
+    session = get_session()
+    if session is None:
+        raise RuntimeError("get_dataset_shard() outside a train session")
+    shard = session.dataset_shards.get(name)
+    if shard is None:
+        raise KeyError(
+            f"no dataset named {name!r} was passed to the trainer "
+            f"(available: {sorted(session.dataset_shards)})")
+    return shard
 
 
 def get_checkpoint() -> Optional[Checkpoint]:

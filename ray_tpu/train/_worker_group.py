@@ -52,12 +52,14 @@ class TrainWorker:
     # ------------------------------------------------------ session verbs
     def session_start(self, train_fn, config, context,
                       starting_checkpoint: Optional[str],
-                      checkpoint_seq_start: int = 0) -> None:
+                      checkpoint_seq_start: int = 0,
+                      dataset_shards=None) -> None:
         from ray_tpu.train import _session
 
         s = _session.init_session(train_fn, config or {}, context,
                                   starting_checkpoint=starting_checkpoint,
-                                  checkpoint_seq_start=checkpoint_seq_start)
+                                  checkpoint_seq_start=checkpoint_seq_start,
+                                  dataset_shards=dataset_shards)
         s.start()
 
     def session_get_next(self, timeout: float):

@@ -146,6 +146,7 @@ class DataParallelTrainer(BaseTrainer):
                  backend_config: Optional[BackendConfig] = None,
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
                  resume_from_checkpoint: Optional[Checkpoint] = None):
         super().__init__(scaling_config=scaling_config, run_config=run_config,
                          resume_from_checkpoint=resume_from_checkpoint)
@@ -155,6 +156,10 @@ class DataParallelTrainer(BaseTrainer):
         self.train_loop_per_worker = train_loop_per_worker
         self.train_loop_config = train_loop_config or {}
         self.backend_config = backend_config or self._default_backend_config
+        # name -> ray_tpu.data.Dataset; split per-worker at fit time and
+        # consumed in the loop via train.get_dataset_shard (reference:
+        # data_parallel_trainer.py datasets= + session dataset_shard)
+        self.datasets = datasets or {}
 
     # ------------------------------------------------------- one attempt
     def training_loop(self) -> Result:
@@ -171,6 +176,17 @@ class DataParallelTrainer(BaseTrainer):
             self.resume_from_checkpoint.path
             if self.resume_from_checkpoint else None)
         last_metrics: Dict[str, Any] = {}
+        # Each named dataset splits into one coordinated streaming iterator
+        # per worker; equal=True keeps lockstep SPMD loops in sync.
+        n_workers = self.scaling_config.num_workers
+        dataset_shards: Optional[list] = None
+        if self.datasets:
+            per_name = {name: ds.streaming_split(n_workers, equal=True)
+                        for name, ds in self.datasets.items()}
+            dataset_shards = [
+                {name: its[rank] for name, its in per_name.items()}
+                for rank in range(n_workers)
+            ]
         try:
             executor.start_training(
                 self.train_loop_per_worker, self.train_loop_config,
@@ -179,6 +195,7 @@ class DataParallelTrainer(BaseTrainer):
                 trial_dir=trial_dir,
                 checkpoint_path=latest_ckpt,
                 checkpoint_seq_start=_next_checkpoint_seq(trial_dir),
+                dataset_shards=dataset_shards,
             )
             while True:
                 results = executor.get_next_results(

@@ -45,6 +45,7 @@ class JaxTrainer(DataParallelTrainer):
                  jax_config: Optional[JaxConfig] = None,
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
+                 datasets: Optional[Dict[str, Any]] = None,
                  resume_from_checkpoint: Optional[Checkpoint] = None):
         super().__init__(
             train_loop_per_worker,
@@ -52,5 +53,6 @@ class JaxTrainer(DataParallelTrainer):
             backend_config=jax_config or JaxConfig(),
             scaling_config=scaling_config,
             run_config=run_config,
+            datasets=datasets,
             resume_from_checkpoint=resume_from_checkpoint,
         )

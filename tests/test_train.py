@@ -216,3 +216,31 @@ def test_jax_trainer_gpt2_sharded_through_actors(ray_start_regular, tmp_path):
     assert result.metrics["mesh"] == {"pp": 1, "dp": 2, "fsdp": 2, "sp": 1,
                                       "tp": 2, "ep": 1}
     assert np.isfinite(result.metrics["loss"])
+
+
+def test_datasets_flow_to_workers(ray_start_regular, tmp_path):
+    """datasets= splits into per-worker streaming iterators consumed via
+    train.get_dataset_shard (reference: ray.train.get_dataset_shard)."""
+    from ray_tpu import data as rd
+
+    def loop(config):
+        shard = train.get_dataset_shard("train")
+        total = 0
+        rows = 0
+        for batch in shard.iter_batches(batch_size=16, drop_last=False):
+            total += int(batch["id"].sum())
+            rows += len(batch["id"])
+        train.report({"total": total, "rows": rows})
+
+    trainer = JaxTrainer(
+        loop,
+        jax_config=JaxConfig(platform="cpu", cpu_devices_per_worker=1),
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="data-train", storage_path=str(tmp_path)),
+        datasets={"train": rd.range(128, parallelism=4)},
+    )
+    result = trainer.fit()
+    # rank0 metrics only; every row lands exactly once across both workers:
+    # check via the history of both workers is not exposed, so assert the
+    # equal split on rank 0
+    assert result.metrics["rows"] == 64
