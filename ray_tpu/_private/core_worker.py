@@ -93,6 +93,7 @@ class CoreWorker:
         session_dir: str = "/tmp/ray_tpu",
         node_id: Optional[NodeID] = None,
         namespace: str = "",
+        remote_plasma: bool = False,
     ):
         self.mode = mode
         self.worker_id = worker_id or WorkerID.from_random()
@@ -138,7 +139,14 @@ class CoreWorker:
                         name="worker->gcs")
         )
         self.gcs_conn._on_close = self._on_gcs_lost
-        self.plasma = PlasmaClient(self.io, self.nodelet_conn)
+        if remote_plasma:
+            # client mode (ray:// — reference: Ray Client): the driver may be
+            # on another machine; objects move over RPC, not shared memory
+            from ray_tpu._private.object_store import RemotePlasmaClient
+
+            self.plasma = RemotePlasmaClient(self.io, self.nodelet_conn)
+        else:
+            self.plasma = PlasmaClient(self.io, self.nodelet_conn)
         self.io.run(self.gcs_conn.call("client_hello",
                                        {"worker_id": self.worker_id.binary()}))
 

@@ -410,6 +410,80 @@ class PlasmaClient:
             pass
 
 
+class RemotePlasmaClient:
+    """RPC-only plasma access for drivers on a DIFFERENT machine than their
+    nodelet (reference role: Ray Client, util/client/ — a remote REPL drives
+    the cluster without local shared memory).  Same surface as PlasmaClient;
+    the data path is the chunked fetch RPC instead of shm mapping, and puts
+    ship bytes inline for the nodelet to write into its store."""
+
+    def __init__(self, io, conn):
+        self._io = io
+        self._conn = conn
+
+    def put(self, oid: ObjectID, flat) -> None:
+        self._put_bytes(oid, bytes(flat))
+
+    def put_serialized(self, oid: ObjectID, ser) -> None:
+        buf = bytearray(ser.total_frame_bytes())
+        ser.write_into(memoryview(buf))
+        self._put_bytes(oid, bytes(buf))
+
+    def _put_bytes(self, oid: ObjectID, data: bytes) -> None:
+        # same transient store-full patience as the local client's _create
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                self._conn.call_sync("plasma_put_bytes",
+                                     {"oid": oid.binary(), "data": data})
+                return
+            except ObjectStoreFullError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(RayConfig.object_store_full_delay_ms / 1000.0)
+
+    def get_mapped(self, oid: ObjectID, timeout=None):
+        """Wait server-side (plasma_get pins), then stream chunks over RPC."""
+        resp = self._conn.call_sync(
+            "plasma_get", {"oid": oid.binary(), "timeout": timeout},
+            timeout=None)
+        if resp is None:
+            return None
+        _name, size = resp
+        try:
+            out = bytearray(size)
+            off = 0
+            chunk = RayConfig.fetch_chunk_bytes
+            while off < size:
+                r = self._conn.call_sync(
+                    "fetch_object_chunk",
+                    {"oid": oid.binary(), "off": off,
+                     "len": min(chunk, size - off)})
+                if r is None:
+                    return None  # evicted mid-fetch; caller retries/recovers
+                out[off:off + len(r["data"])] = r["data"]
+                off += len(r["data"])
+            return memoryview(out)
+        finally:
+            try:
+                self._conn.call_sync("plasma_release", {"oid": oid.binary()})
+            except ConnectionError:
+                pass
+
+    def contains(self, oid: ObjectID) -> bool:
+        return self._conn.call_sync("plasma_contains", {"oid": oid.binary()})
+
+    def release(self, oid: ObjectID) -> None:
+        pass  # no local mapping to drop; the pin is released in get_mapped
+
+    def free(self, oids) -> None:
+        try:
+            self._conn.call_sync(
+                "plasma_delete", {"oids": [o.binary() for o in oids]})
+        except ConnectionError:
+            pass
+
+
 def register_store_handlers(handlers: dict, store: PlasmaStore, waiters: dict,
                             on_miss=None) -> None:
     """Wire plasma_* RPC methods into a nodelet server handler table.
@@ -471,6 +545,18 @@ def register_store_handlers(handlers: dict, store: PlasmaStore, waiters: dict,
             _track_pin(conn, oid)
         return entry
 
+    async def plasma_put_bytes(conn, msg):
+        """Client-mode put: the driver ships bytes; this node materializes
+        the object in its store (reference: Ray Client proxying ray.put)."""
+        oid = ObjectID(msg["oid"])
+        # write through the store's own mapping (a raw SharedMemory attach
+        # here would double-register with the resource tracker)
+        store.write_and_seal(oid, memoryview(msg["data"]))
+        for fut in waiters.pop(oid, []):
+            if not fut.done():
+                fut.set_result(True)
+        return True
+
     async def plasma_contains(conn, msg):
         return store.contains(ObjectID(msg["oid"]))
 
@@ -493,6 +579,7 @@ def register_store_handlers(handlers: dict, store: PlasmaStore, waiters: dict,
         return store.stats()
 
     handlers.update(
+        plasma_put_bytes=plasma_put_bytes,
         plasma_create=plasma_create,
         plasma_seal=plasma_seal,
         plasma_get=plasma_get,

@@ -87,6 +87,13 @@ def init(
             # submitted-job drivers and `ray_tpu start` shells connect to the
             # running cluster via the env (reference: RAY_ADDRESS)
             address = os.environ.get("RAY_TPU_ADDRESS") or None
+        # ray:// scheme = client mode (reference: Ray Client, util/client/):
+        # the driver may be on a DIFFERENT machine; object data moves over
+        # RPC instead of shared memory.
+        client_mode = False
+        if address and address.startswith("ray://"):
+            client_mode = True
+            address = address[len("ray://"):]
         node = None
         if address is None or address == "local":
             res = dict(resources or {})
@@ -112,6 +119,7 @@ def init(
             mode="driver",
             gcs_addr=gcs_addr,
             nodelet_addr=nodelet_addr,
+            remote_plasma=client_mode,
             namespace=namespace,
         )
         core.register_with_nodelet()
