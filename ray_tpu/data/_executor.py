@@ -381,26 +381,7 @@ def _repartition(refs, metas, n_out: int) -> List[RefBundle]:
     total = sum(m.num_rows for m in metas)
     per = [total // n_out + (1 if i < total % n_out else 0)
            for i in range(n_out)]
-    # slice source blocks into runs, then concat per output
-    out: List[RefBundle] = []
-    src = 0
-    offset = 0
-    for want in per:
-        parts = []
-        need = want
-        while need > 0 and src < len(refs):
-            avail = metas[src].num_rows - offset
-            take = min(avail, need)
-            parts.append(_slice_block.remote(refs[src], offset, offset + take)[0])
-            offset += take
-            need -= take
-            if offset >= metas[src].num_rows:
-                src += 1
-                offset = 0
-        bref, mref = _concat_blocks.remote(*parts) if parts else \
-            _concat_blocks.remote()
-        out.append((bref, ray_tpu.get(mref)))
-    return out
+    return _repartition_to(refs, metas, per)
 
 
 @ray_tpu.remote
@@ -484,11 +465,14 @@ def _sort(refs, metas, key: str, descending: bool) -> List[RefBundle]:
     if not refs:
         return []
     samples = ray_tpu.get([_sort_sample.remote(r, key) for r in refs])
-    allsamp = np.sort(np.concatenate([s for s in samples if len(s)]))
+    non_empty = [s for s in samples if len(s)]
     n_out = len(refs)
-    if len(allsamp) == 0:
-        bounds = np.asarray([])
+    if not non_empty:
+        # every block is empty: still emit n_out (empty) parts per mapper so
+        # the reduce arity matches num_returns
+        bounds = np.zeros(max(n_out - 1, 0))
     else:
+        allsamp = np.sort(np.concatenate(non_empty))
         idx = np.linspace(0, len(allsamp) - 1, n_out + 1).astype(int)[1:-1]
         bounds = allsamp[idx]
     by_reducer = _scatter(_sort_map, refs, n_out, lambda i: (key, bounds))

@@ -89,7 +89,12 @@ class DataIterator:
 def _rebatch(blocks: Iterator[Block], batch_size: Optional[int],
              drop_last: bool, shuffle_buffer: Optional[int],
              rng) -> Iterator[Block]:
-    """Slice/stitch a block stream into exact-size batches."""
+    """Slice/stitch a block stream into exact-size batches.
+
+    Shuffle path: the buffer is merged + permuted once per REFILL and then
+    emitted as slices — permuting the whole buffer per emitted batch would
+    cost O(buffer) memcpy per batch (reference: shuffling batcher semantics).
+    """
     if batch_size is None:
         yield from (b for b in blocks if BlockAccessor.num_rows(b))
         return
@@ -102,27 +107,32 @@ def _rebatch(blocks: Iterator[Block], batch_size: Optional[int],
             continue
         buf.append(block)
         buffered += n
-        while buffered >= batch_size + min_buf:
+        if buffered >= batch_size + min_buf:
             merged = BlockAccessor.concat(buf)
             if shuffle_buffer:
                 perm = rng.permutation(BlockAccessor.num_rows(merged))
                 merged = BlockAccessor.take_idx(merged, perm)
-            yield BlockAccessor.slice(merged, 0, batch_size)
-            rest = BlockAccessor.slice(merged, batch_size,
-                                       BlockAccessor.num_rows(merged))
+            # emit whole batches down to the shuffle floor, keep the tail
+            pos = 0
+            total = BlockAccessor.num_rows(merged)
+            while total - pos >= batch_size + min_buf:
+                yield BlockAccessor.slice(merged, pos, pos + batch_size)
+                pos += batch_size
+            rest = BlockAccessor.slice(merged, pos, total)
             buf = [rest] if BlockAccessor.num_rows(rest) else []
-            buffered -= batch_size
+            buffered = total - pos
     if buffered:
         merged = BlockAccessor.concat(buf)
         if shuffle_buffer:
             perm = rng.permutation(BlockAccessor.num_rows(merged))
             merged = BlockAccessor.take_idx(merged, perm)
-        while BlockAccessor.num_rows(merged) >= batch_size:
-            yield BlockAccessor.slice(merged, 0, batch_size)
-            merged = BlockAccessor.slice(merged, batch_size,
-                                         BlockAccessor.num_rows(merged))
-        if BlockAccessor.num_rows(merged) and not drop_last:
-            yield merged
+        pos = 0
+        total = BlockAccessor.num_rows(merged)
+        while total - pos >= batch_size:
+            yield BlockAccessor.slice(merged, pos, pos + batch_size)
+            pos += batch_size
+        if pos < total and not drop_last:
+            yield BlockAccessor.slice(merged, pos, total)
 
 
 # ===================================================== streaming split
@@ -147,18 +157,29 @@ class _SplitCoordinator:
         self._exhausted = False
         self._rebalanced = False
 
-    def _ensure_epoch(self, epoch: int):
-        if epoch > self._epoch:
-            from ray_tpu.data._executor import StreamingExecutor
+    def _ensure_epoch(self, epoch: int, split_idx: int) -> bool:
+        """Returns True when the requested epoch is active.  The epoch flips
+        only once the CURRENT one is fully delivered (generator exhausted and
+        every queue drained) — flipping on the first request would wipe
+        slower consumers' undelivered queues mid-epoch (lost/duplicated rows,
+        desynced SPMD workers).  Serial consumers still work: by the time one
+        asks for the next epoch serially, the previous one is complete."""
+        if epoch <= self._epoch:
+            return True
+        if self._epoch >= 0 and not (
+                self._exhausted and all(not q for q in self._queues)):
+            return False  # stragglers still draining the previous epoch
+        from ray_tpu.data._executor import StreamingExecutor
 
-            self._gen = StreamingExecutor(self._plan).execute()
-            self._epoch = epoch
-            self._exhausted = False
-            self._rebalanced = False
-            for q in self._queues:
-                q.clear()
-            self._rows = [0] * self._n
-            self._delivered = [0] * self._n
+        self._gen = StreamingExecutor(self._plan).execute()
+        self._epoch = epoch
+        self._exhausted = False
+        self._rebalanced = False
+        for q in self._queues:
+            q.clear()
+        self._rows = [0] * self._n
+        self._delivered = [0] * self._n
+        return True
 
     def _deal_until(self, split_idx: int, want: int):
         q = self._queues[split_idx]
@@ -175,8 +196,10 @@ class _SplitCoordinator:
             self._rows[tgt] += meta.num_rows
 
     def get_next(self, split_idx: int, epoch: int):
-        """Return (block_ref, num_rows) or None when the epoch is done."""
-        self._ensure_epoch(epoch)
+        """Return (block_ref, num_rows), the string "wait" (epoch barrier not
+        passed yet — caller retries), or None when the epoch is done."""
+        if not self._ensure_epoch(epoch, split_idx):
+            return "wait"
         q = self._queues[split_idx]
         # equal=True holds back one block per consumer until the stream's total
         # is known, then rebalances so every split delivers EXACTLY total//n
@@ -228,12 +251,17 @@ class _SplitIterator(DataIterator):
         super().__init__(self._pull_blocks)
 
     def _pull_blocks(self):
+        import time
+
         self._epoch += 1
         while True:
             item = ray_tpu.get(
                 self._coord.get_next.remote(self._idx, self._epoch))
             if item is None:
                 return
+            if item == "wait":  # epoch barrier: others still draining
+                time.sleep(0.05)
+                continue
             ref, _rows = item
             yield ray_tpu.get(ref)
 

@@ -260,3 +260,10 @@ def test_random_sample_not_position_correlated(cluster):
     # a position-correlated sample hits ~half the positions; an independent
     # one hits nearly all of them
     assert len(positions) > period * 0.9, len(positions)
+
+
+def test_sort_all_empty_blocks(cluster):
+    # regression: all-empty inputs crashed the sample-based sort
+    ds = rd.range(40, parallelism=4).filter(lambda r: False)
+    assert ds.sort("id").take_all() == []
+    assert ds.count() == 0
