@@ -184,10 +184,20 @@ class CoreWorker:
         self.actor_instance: Any = None
         self.actor_id: Optional[ActorID] = None
         self._actor_sem: Optional[asyncio.Semaphore] = None
+        self._task_sem: Optional[asyncio.Semaphore] = None
         self._exec_queue: Optional[asyncio.Queue] = None
         self._dispatch_task = None
         if mode == "worker":
-            self.executor_pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="rtpu-exec")
+            # Concurrency matches the submitter's per-lease pipeline depth:
+            # every pipelined task gets a thread IMMEDIATELY, so a task that
+            # blocks on a nested ray.get can't head-of-line-block the tasks
+            # queued behind it (they run concurrently; resource oversubscribe
+            # is bounded by the depth, mirroring the reference's
+            # blocked-worker CPU release).
+            depth = max(RayConfig.lease_pipeline_depth, 1)
+            self.executor_pool = ThreadPoolExecutor(
+                max_workers=depth, thread_name_prefix="rtpu-exec")
+            self._task_sem = asyncio.Semaphore(depth)
             self._exec_queue = asyncio.Queue()
             self._dispatch_task = self.io.spawn(self._execute_loop())
 
@@ -1080,18 +1090,39 @@ class CoreWorker:
 
     # ============================================================ execution
     async def _execute_loop(self):
-        """Serialized dispatch: tasks run in arrival order; concurrency bounded
-        by the actor's max_concurrency (reference: actor_scheduling_queue.h)."""
+        """Dispatch in arrival order.  Actor tasks: concurrency bounded by
+        max_concurrency (reference: actor_scheduling_queue.h).  Normal tasks:
+        bounded by the lease pipeline depth (see __init__); actor CREATION
+        still runs inline so the actor exists before its first method call."""
         while True:
             item = await self._exec_queue.get()
             spec, reply_fut = item
             if self._actor_sem is not None:
                 await self._actor_sem.acquire()
                 asyncio.get_event_loop().create_task(self._run_one(spec, reply_fut, release=True))
+            elif spec.task_type == TaskType.NORMAL_TASK and \
+                    self._task_sem is not None:
+                if spec.runtime_env:
+                    # env application mutates process-global state
+                    # (os.environ, cwd, sys.path): run EXCLUSIVELY by
+                    # draining every pipeline permit first
+                    depth = max(RayConfig.lease_pipeline_depth, 1)
+                    for _ in range(depth):
+                        await self._task_sem.acquire()
+                    try:
+                        await self._run_one(spec, reply_fut, release=False)
+                    finally:
+                        for _ in range(depth):
+                            self._task_sem.release()
+                else:
+                    await self._task_sem.acquire()
+                    asyncio.get_event_loop().create_task(
+                        self._run_one(spec, reply_fut, release_task=True))
             else:
                 await self._run_one(spec, reply_fut, release=False)
 
-    async def _run_one(self, spec: TaskSpec, reply_fut: asyncio.Future, release: bool):
+    async def _run_one(self, spec: TaskSpec, reply_fut: asyncio.Future,
+                       release: bool = False, release_task: bool = False):
         self.emit_task_event(spec, "RUNNING")
         try:
             result = await self._execute_spec(spec)
@@ -1101,6 +1132,8 @@ class CoreWorker:
         finally:
             if release and self._actor_sem is not None:
                 self._actor_sem.release()
+            if release_task and self._task_sem is not None:
+                self._task_sem.release()
         if result.get("status") == "ok":
             self.emit_task_event(spec, "FINISHED")
         elif RayConfig.task_events_enabled:
@@ -1154,6 +1187,12 @@ class CoreWorker:
     async def _execute_spec(self, spec: TaskSpec) -> dict:
         loop = asyncio.get_event_loop()
         if spec.task_type == TaskType.ACTOR_CREATION_TASK:
+            # dedicated single thread from __init__ onward: a reused task
+            # worker's depth-wide pool would run successive (serialized)
+            # actor methods on DIFFERENT threads, breaking thread-affine
+            # state like sqlite handles (async actors re-widen later)
+            self.executor_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="rtpu-actor-exec")
             return await loop.run_in_executor(self.executor_pool, self._create_actor_sync, spec)
         if spec.task_type == TaskType.ACTOR_TASK:
             method = getattr(self.actor_instance, spec.actor_method_name, None)
@@ -1377,13 +1416,34 @@ class NormalTaskSubmitter:
                 spec.args[i] = InlineArg(value.inband, [bytes(b) for b in value.buffers])
 
     async def _pump(self, key, st):
+        # Pipelined dispatch: a lease accepts up to lease_pipeline_depth
+        # in-flight tasks (the worker's exec queue serializes them), so
+        # submission overhead overlaps execution instead of paying a full
+        # round trip per task (reference: NormalTaskSubmitter pipelining on
+        # leased-worker connections).
+        depth = RayConfig.lease_pipeline_depth
         while st["pending"] and st["idle"]:
-            spec, holds = st["pending"].popleft()
             lease = st["idle"].pop()
+            if lease.get("returned"):
+                continue  # raced with _return_idle: worker no longer ours
+            spec, holds = st["pending"].popleft()
+            lease["inflight"] = lease.get("inflight", 0) + 1
+            if lease["inflight"] < depth:
+                # spare capacity: keep dispatchable.  LIFO on purpose: PACK a
+                # lease up to depth before touching the next one — fewer hot
+                # worker processes beats even spreading (saturated leases drop
+                # out of idle, so overflow spills to the next worker anyway)
+                st["idle"].append(lease)
             asyncio.get_event_loop().create_task(
                 self._push_one(key, st, spec, holds, lease))
         max_pending = RayConfig.max_pending_lease_requests_per_scheduling_category
-        want = min(len(st["pending"]), max_pending) - st["inflight"]
+        # Credit the pipeline capacity of leases we already hold: demand that
+        # fits on existing workers must not spawn new ones (process churn
+        # costs more than it buys, especially on small hosts).
+        spare = sum(max(depth - l.get("inflight", 0), 0)
+                    for l in st["idle"] if not l.get("returned"))
+        effective = max(len(st["pending"]) - spare, 0)
+        want = min(effective, max_pending) - st["inflight"]
         for _ in range(max(want, 0)):
             st["inflight"] += 1
             asyncio.get_event_loop().create_task(self._request_lease(key, st))
@@ -1407,8 +1467,22 @@ class NormalTaskSubmitter:
             asyncio.get_event_loop().create_task(_fire())
 
     async def _return_idle(self, st):
-        while st["idle"]:
-            lease = st["idle"].pop()
+        # Pipelining keeps a lease in "idle" while it still has tasks in
+        # flight (spare capacity).  Returning such a lease would mark the
+        # worker idle at the nodelet MID-TASK — it could then be leased to an
+        # actor and two programs would share one process.  Only truly-empty
+        # leases go back.
+        # Partition synchronously BEFORE any await: leases re-added by a
+        # concurrent _push_one during the awaits must not be double-returned,
+        # and a returned lease must never re-enter circulation (the
+        # "returned" flag is checked by _pump and _push_one).
+        busy_leases = [l for l in st["idle"] if l.get("inflight", 0) > 0]
+        to_return = [l for l in st["idle"]
+                     if l.get("inflight", 0) == 0 and not l.get("returned")]
+        st["idle"] = busy_leases
+        for lease in to_return:
+            lease["returned"] = True
+        for lease in to_return:
             try:
                 await lease["nodelet_conn"].call("return_worker", {"lease_id": lease["lease_id"]})
             except (ConnectionError, asyncio.TimeoutError):
@@ -1582,8 +1656,12 @@ class NormalTaskSubmitter:
                     f"worker died while running task {spec.name}: {e}"), holds)
         finally:
             st["busy"] -= 1
-            if worker_ok:
+            lease["inflight"] = max(lease.get("inflight", 1) - 1, 0)
+            if worker_ok and not lease.get("returned") \
+                    and not any(l is lease for l in st["idle"]):
                 st["idle"].append(lease)
+            elif not worker_ok and any(l is lease for l in st["idle"]):
+                st["idle"] = [l for l in st["idle"] if l is not lease]
             await self._pump(key, st)
 
 

@@ -490,7 +490,7 @@ def _sort(refs, metas, key: str, descending: bool) -> List[RefBundle]:
 def _hash_partition(block: Block, keys: List[str], n_out: int):
     n = BlockAccessor.num_rows(block)
     if n == 0:
-        return [block] * n_out
+        return block if n_out == 1 else tuple([block] * n_out)
     import hashlib
 
     def stable(x):

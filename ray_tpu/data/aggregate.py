@@ -92,18 +92,12 @@ class Std(AggregateFn):
     def __init__(self, on: Optional[str] = None, ddof: int = 1):
         def acc(a, b):
             col = _col(b, on).astype(np.float64)
-            n, mean, m2 = a
-            for chunk_n, chunk_mean, chunk_m2 in [(
-                    len(col), float(col.mean()) if len(col) else 0.0,
-                    float(((col - col.mean()) ** 2).sum()) if len(col) else 0.0)]:
-                if chunk_n == 0:
-                    continue
-                delta = chunk_mean - mean
-                tot = n + chunk_n
-                m2 = m2 + chunk_m2 + delta ** 2 * n * chunk_n / tot
-                mean = mean + delta * chunk_n / tot
-                n = tot
-            return (n, mean, m2)
+            if len(col) == 0:
+                return a
+            chunk_mean = float(col.mean())
+            chunk = (len(col), chunk_mean,
+                     float(((col - chunk_mean) ** 2).sum()))
+            return merge(a, chunk)
 
         def merge(a, b):
             n1, mean1, m21 = a

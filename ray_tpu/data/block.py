@@ -152,6 +152,13 @@ class BlockAccessor:
         if len(blocks) == 1:
             return blocks[0]
         keys = list(blocks[0].keys())
+        for i, b in enumerate(blocks[1:], 1):
+            if set(b.keys()) != set(keys):
+                # loud beats silent column loss (reference: Arrow unification
+                # errors on incompatible schemas)
+                raise ValueError(
+                    f"cannot concat blocks with mismatched columns: "
+                    f"{sorted(keys)} vs {sorted(b.keys())} (block {i})")
         out = {}
         for k in keys:
             cols = [b[k] for b in blocks]

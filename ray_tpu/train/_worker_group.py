@@ -77,10 +77,21 @@ class TrainWorker:
 
 
 def _local_ip() -> str:
-    # UDP-connect trick needs no actual traffic, but a private-VPC host may
-    # have no route to 8.8.8.8 at all — fall back to the hostname's address
-    # before loopback (loopback as a coordinator address breaks every
-    # nonzero-rank host).
+    # Best source: the local address of this worker's live GCS connection —
+    # a route PROVEN to reach the cluster (the 8.8.8.8 UDP trick can return
+    # an unroutable interface, e.g. a TEST-NET tunnel address, and loopback
+    # as a coordinator address breaks every nonzero-rank host).
+    try:
+        from ray_tpu._private import worker as worker_mod
+
+        core = worker_mod.global_worker_core()
+        if core is not None and not core.gcs_conn.closed:
+            sockname = core.gcs_conn._writer.get_extra_info("sockname")
+            if sockname and sockname[0] not in ("0.0.0.0", "::", "::1") \
+                    and not sockname[0].startswith("127."):
+                return sockname[0]
+    except Exception:
+        pass
     try:
         with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
             s.connect(("8.8.8.8", 80))
