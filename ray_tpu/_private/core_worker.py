@@ -1436,7 +1436,12 @@ class NormalTaskSubmitter:
                 st["idle"].append(lease)
             asyncio.get_event_loop().create_task(
                 self._push_one(key, st, spec, holds, lease))
-        max_pending = RayConfig.max_pending_lease_requests_per_scheduling_category
+        # Lease-request parallelism beyond the host's cores only buys process
+        # churn: every granted lease is a worker process contending for the
+        # same CPUs (the config cap still bounds big hosts).
+        max_pending = min(
+            RayConfig.max_pending_lease_requests_per_scheduling_category,
+            max(2, os.cpu_count() or 4))
         # Credit the pipeline capacity of leases we already hold: demand that
         # fits on existing workers must not spawn new ones (process churn
         # costs more than it buys, especially on small hosts).

@@ -81,7 +81,7 @@ def run_microbenchmarks(duration_s: float = 2.0,
 
     # ------------------------------------------------ tasks, async batches
     def tasks_async():
-        n = 200
+        n = 1000  # reference ray_perf uses 1000-task async batches
         ray_tpu.get([noop_small.remote() for _ in range(n)])
         return n
 
@@ -100,7 +100,7 @@ def run_microbenchmarks(duration_s: float = 2.0,
     _settle()
 
     def actor_async():
-        n = 200
+        n = 1000  # reference ray_perf batch size
         ray_tpu.get([actor.ping.remote() for _ in range(n)])
         return n
 
