@@ -368,6 +368,7 @@ class CoreWorker:
         ConnectionLost; retry loops around the runtime already tolerate that."""
         deadline = time.monotonic() + RayConfig.gcs_reconnect_timeout_s
         delay = 0.2
+        handed_off = False
         try:
             while not self._shut:
                 await asyncio.sleep(delay)
@@ -385,6 +386,11 @@ class CoreWorker:
                     conn._on_close = self._on_gcs_lost
                     logger.info("reconnected to the GCS")
                     if conn.closed:
+                        # Hand off to a fresh loop.  The flag must stay
+                        # owned by that loop: clearing it again in our
+                        # finally would let a later drop spawn a third
+                        # concurrent loop racing on self.gcs_conn.
+                        handed_off = True
                         self._gcs_reconnecting = False
                         self._on_gcs_lost(conn)
                     return
@@ -400,7 +406,8 @@ class CoreWorker:
                         deadline = float("inf")
                     delay = min(delay * 1.5, 5.0)
         finally:
-            self._gcs_reconnecting = False
+            if not handed_off:
+                self._gcs_reconnecting = False
 
     # ======================================================== object: put/get
     def _next_put_id(self) -> ObjectID:
@@ -1191,8 +1198,14 @@ class CoreWorker:
             # worker's depth-wide pool would run successive (serialized)
             # actor methods on DIFFERENT threads, breaking thread-affine
             # state like sqlite handles (async actors re-widen later)
+            old_pool = self.executor_pool
             self.executor_pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="rtpu-actor-exec")
+            if old_pool is not None:
+                # Don't leak the depth-wide task pool's idle threads for the
+                # actor's lifetime; non-blocking so an in-flight normal task
+                # can still drain.
+                old_pool.shutdown(wait=False)
             return await loop.run_in_executor(self.executor_pool, self._create_actor_sync, spec)
         if spec.task_type == TaskType.ACTOR_TASK:
             method = getattr(self.actor_instance, spec.actor_method_name, None)
@@ -1254,8 +1267,11 @@ class CoreWorker:
             # async actors get max_concurrency=1000 unless set explicitly).
             conc = spec.max_concurrency if spec.max_concurrency > 1 else 1000
             self._actor_sem = asyncio.Semaphore(conc)
+            old_pool = self.executor_pool
             self.executor_pool = ThreadPoolExecutor(
                 max_workers=conc, thread_name_prefix="rtpu-actor")
+            if old_pool is not None:
+                old_pool.shutdown(wait=False)
         return {"status": "ok", "returns": []}
 
     def _invoke_sync(self, spec: TaskSpec, fn) -> dict:

@@ -45,7 +45,7 @@ class NodeInfo:
     __slots__ = ("node_id", "addr", "resources_total", "resources_available",
                  "labels", "conn", "alive", "last_seen", "start_time", "node_name",
                  "object_store_capacity", "death_cause", "pending_demand",
-                 "metrics_addr")
+                 "metrics_addr", "busy_workers")
 
     def __init__(self, node_id: NodeID, addr: Tuple[str, int], resources_total: Dict[str, float],
                  labels: Dict[str, str], conn: rpc.Connection, node_name: str = ""):
@@ -63,6 +63,7 @@ class NodeInfo:
         self.metrics_addr: Optional[Tuple[str, int]] = None  # /metrics scrape
         self.object_store_capacity = 0
         self.death_cause = ""
+        self.busy_workers = 0  # leased workers + live actors (idle detection)
 
     def view(self) -> dict:
         return {
@@ -398,6 +399,7 @@ class GcsServer:
         info.last_seen = time.monotonic()
         info.resources_available = msg["available"]
         info.pending_demand = msg.get("pending_demand", [])
+        info.busy_workers = msg.get("busy_workers", 0)
         if msg.get("total"):
             info.resources_total = msg["total"]
         # Broadcast the delta so every nodelet's cluster view converges
@@ -433,12 +435,20 @@ class GcsServer:
                  "alive": n.alive, "total": n.resources_total,
                  "available": n.resources_available,
                  "labels": n.labels, "start_time": n.start_time,
-                 "idle": all(
+                 # A node hosting any leased worker or live actor is never
+                 # idle, even when resource accounting looks free: queue
+                 # actors / Serve replicas default to num_cpus=0 and would
+                 # otherwise be torn down with their state (advisor r3).
+                 "idle": n.busy_workers == 0 and all(
                      n.resources_available.get(k, 0.0) >= v
                      for k, v in n.resources_total.items())}
                 for n in self.nodes.values()
             ],
             "pending_demand": demand,
+            # Degraded persistence (e.g. disk full): the cluster runs, but a
+            # GCS restart may restore stale state.  Surfaced here so `status`
+            # CLI / dashboards can warn before the restart happens.
+            "gcs_storage_degraded": getattr(self.store, "degraded", False),
         }
 
     async def rpc_get_cluster_view(self, conn, msg):

@@ -18,10 +18,14 @@ raw bytes for KV).
 
 from __future__ import annotations
 
+import logging
 import os
 import sqlite3
 import threading
+import time
 from typing import Dict, Iterator, Optional, Tuple
+
+logger = logging.getLogger(__name__)
 
 
 class StoreClient:
@@ -85,6 +89,10 @@ class SqliteStoreClient(StoreClient):
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
         self._path = path
         self._lock = threading.Lock()
+        # Flipped on the first write failure: the cluster keeps running, but
+        # FT restore may be stale — health endpoints surface this.
+        self.degraded = False
+        self._last_error_log = 0.0
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA synchronous=NORMAL")
@@ -111,8 +119,17 @@ class SqliteStoreClient(StoreClient):
                     # coalesce: commit once per drained burst
                     if self._queue.empty():
                         self._conn.commit()
-            except sqlite3.Error:
-                pass  # persistence must never take down the control plane
+            except sqlite3.Error as e:
+                # Persistence must never take down the control plane, but a
+                # silent stop (disk full, corrupt WAL) would let a later GCS
+                # restart restore stale state with no prior warning.
+                self.degraded = True
+                now = time.monotonic()
+                if now - self._last_error_log > 10.0:
+                    self._last_error_log = now
+                    logger.error(
+                        "GCS persistence write failed (%s): durability is "
+                        "degraded; a restart may restore stale state", e)
             finally:
                 self._queue.task_done()
 

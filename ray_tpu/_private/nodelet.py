@@ -302,11 +302,18 @@ class Nodelet:
                     else:
                         demand.append(dict(res))
                 self._update_builtin_metrics()
+                # Zero-resource actors (num_cpus=0 queues, Serve replicas)
+                # don't show up in resource accounting, so the autoscaler
+                # must not infer idleness from available==total alone.
+                busy = sum(1 for w in self.workers.values()
+                           if w.state == "leased"
+                           or (w.is_actor and w.state != "dead"))
                 resp = await self.gcs.call("resource_report", {
                     "node_id": self.node_id.binary(),
                     "available": self.resources_available,
                     "total": self.resources_total,
                     "pending_demand": demand,
+                    "busy_workers": busy,
                 }, timeout=RayConfig.gcs_rpc_timeout_s)
                 if resp.get("dead"):
                     logger.error("GCS declared this node dead; exiting")

@@ -107,6 +107,11 @@ class _OpState:
         self.upstream_done = False
         self.done = False
         self.rows_out = 0
+        # Rows the executor has yielded to the caller from this op's output.
+        # Kept separate from rows_out: Limit uses rows_out as its consumed-row
+        # cap, so counting yielded bundles there again would under-emit when
+        # input streams in across scheduler iterations.
+        self.rows_emitted = 0
         self.tasks_launched = 0
         # actor pool
         self.pool: List[Any] = []
@@ -156,7 +161,7 @@ class StreamingExecutor:
             self._propagate(states)
             while final.output:
                 ref, meta = final.output.popleft()
-                final.rows_out += meta.num_rows
+                final.rows_emitted += meta.num_rows
                 yield ref, meta
                 progressed = True
             if final.done:
@@ -165,7 +170,8 @@ class StreamingExecutor:
                 self._wait_any()
         for st in states:
             self._stats[st.name] = {
-                "tasks": st.tasks_launched, "rows_out": st.rows_out}
+                "tasks": st.tasks_launched,
+                "rows_out": max(st.rows_out, st.rows_emitted)}
 
     def _seed_source(self, src: _OpState):
         op = src.op

@@ -144,6 +144,9 @@ def _cmd_status(args) -> int:
             print(f"  {count} x {shape}")
     else:
         print("no pending demand")
+    if status.get("gcs_storage_degraded"):
+        print("WARNING: GCS persistence is degraded (writes failing); "
+              "a GCS restart may restore stale state")
     return 0
 
 

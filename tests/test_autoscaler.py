@@ -74,6 +74,36 @@ def test_scale_up_on_demand_then_reap(scaled_cluster):
         scaler.stop()
 
 
+def test_zero_resource_actor_blocks_idle(scaled_cluster):
+    # Regression (advisor r3): a node hosting only zero-resource actors
+    # (queues, Serve replicas) looked idle to the autoscaler because
+    # available == total, so _scale_down could reap it and destroy state.
+    cluster, provider, _ = scaled_cluster
+
+    @ray_tpu.remote(num_cpus=0)
+    class Holder:
+        def ping(self):
+            return "ok"
+
+    a = Holder.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "ok"
+
+    def any_busy():
+        status = _gcs_call("get_cluster_status", {})
+        return any(not n["idle"] for n in status["nodes"] if n["alive"])
+
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and not any_busy():
+        time.sleep(0.25)
+    assert any_busy(), "node hosting a num_cpus=0 actor reported idle"
+
+    ray_tpu.kill(a)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and any_busy():
+        time.sleep(0.25)
+    assert not any_busy(), "node still busy after its only actor was killed"
+
+
 def test_min_workers_and_binpack():
     """Pure bin-packing logic (no cluster): demand packs onto the fewest
     new nodes and respects max_workers."""

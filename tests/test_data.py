@@ -129,6 +129,18 @@ def test_limit_union_zip(cluster):
     assert all(r["b"] == r["id"] * 2 for r in rows)
 
 
+def test_limit_spanning_streamed_blocks(cluster):
+    # Regression (advisor r3): when Limit is the terminal op, rows the
+    # executor yielded were double-counted against the limit cap, so a limit
+    # spanning multiple streaming blocks under-emitted (100 over 40-row
+    # blocks -> 60 rows).
+    ds = rd.range(200, parallelism=5).limit(100)  # 40-row blocks
+    rows = ds.take_all()
+    assert len(rows) == 100
+    assert [r["id"] for r in rows] == list(range(100))
+    assert rd.range(200, parallelism=5).limit(100).count() == 100
+
+
 def test_iter_batches_exact_sizes(cluster):
     ds = rd.range(100, parallelism=7)
     sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=32)]
