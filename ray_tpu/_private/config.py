@@ -102,7 +102,8 @@ _d("max_io_workers", int, 2, "spill/restore IO workers")
 _d("scheduler_spread_threshold", float, 0.5, "hybrid policy: pack below this utilization, then spread")
 _d("scheduler_top_k_fraction", float, 0.2, "hybrid policy: random choice among top-k nodes")
 _d("max_pending_lease_requests_per_scheduling_category", int, 10, "pipelined lease requests")
-_d("lease_pipeline_depth", int, 8, "in-flight tasks per leased worker (exec queue serializes)")
+_d("lease_pipeline_depth", int, 48, "in-flight tasks per leased worker (exec queue serializes)")
+_d("worker_exec_threads", int, 12, "executor threads per worker (chunks share threads, so this can be < pipeline depth)")
 
 # --- Object store ---
 _d("object_store_memory_bytes", int, 2 * 1024**3, "default per-node shm store capacity")

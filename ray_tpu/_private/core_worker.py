@@ -32,6 +32,7 @@ import pickle
 import random
 import threading
 import time
+import weakref
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
@@ -98,6 +99,10 @@ class CoreWorker:
         self.mode = mode
         self.worker_id = worker_id or WorkerID.from_random()
         self.node_id = node_id
+        # Hot-path constants for emit_task_event (one per task lifecycle hop).
+        self._worker_id_hex = self.worker_id.hex()
+        self._node_id_hex = node_id.hex() if node_id else None
+        self._pid = os.getpid()
         self.session_dir = session_dir
         self.namespace = namespace
         self.job_id = JobID.from_int(0)
@@ -117,7 +122,18 @@ class CoreWorker:
             if name.startswith("rpc_"):
                 handlers[name[4:]] = getattr(self, name)
         self.server = rpc.Server(handlers, name=f"worker-{self.worker_id.hex()[:6]}")
+        self._rpc_handlers = handlers
         self.addr: Tuple[str, int] = self.io.run(self.server.start("127.0.0.1", 0))
+        # Completion routing for batched task submission: task_id -> callback
+        # invoked with the result item when the executor's tasks_done notify
+        # arrives.  IO-loop-thread only.
+        self._completion_router: Dict[bytes, Any] = {}
+        # Executor side: per-connection buffer of finished-task results, so
+        # completions landing in the same loop tick coalesce into one frame.
+        self._done_buf: Dict[Any, list] = {}
+        # Normal-task inflight registry per worker connection: lets a closed
+        # connection fail/retry exactly the tasks that were riding it.
+        self._conn_tasks: Dict[Any, set] = {}
 
         # Connections.
         self.nodelet_conn: rpc.Connection = self.io.run(
@@ -174,8 +190,10 @@ class CoreWorker:
         self.submitter = NormalTaskSubmitter(self)
         self.actor_submitters: Dict[ActorID, ActorTaskSubmitter] = {}
 
-        self._fn_cache: Dict[str, Any] = {}
+        self._fn_cache: Dict[Any, Any] = {}
         self._pushed_fns: set = set()
+        self._fn_payload_cache: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
 
         self._get_pool = ThreadPoolExecutor(max_workers=4, thread_name_prefix="rtpu-get")
 
@@ -195,9 +213,14 @@ class CoreWorker:
             # is bounded by the depth, mirroring the reference's
             # blocked-worker CPU release).
             depth = max(RayConfig.lease_pipeline_depth, 1)
+            # Fewer threads than pipelined tasks: chunked execution packs a
+            # whole burst onto one thread, so the pool only needs enough
+            # threads to ride out tasks that block on nested gets.
+            threads = min(depth, max(RayConfig.worker_exec_threads, 1))
             self.executor_pool = ThreadPoolExecutor(
-                max_workers=depth, thread_name_prefix="rtpu-exec")
-            self._task_sem = asyncio.Semaphore(depth)
+                max_workers=threads, thread_name_prefix="rtpu-exec")
+            self._task_permits = threads
+            self._task_sem = asyncio.Semaphore(threads)
             self._exec_queue = asyncio.Queue()
             self._dispatch_task = self.io.spawn(self._execute_loop())
 
@@ -207,6 +230,7 @@ class CoreWorker:
         # events drop when the buffer overflows, never blocking the task path.
         self._task_events: deque = deque(
             maxlen=RayConfig.task_events_max_buffer_size)
+        self._flush_scheduled = False
         self._shut = False  # must exist before the flush loop's first check
         if RayConfig.task_events_enabled:
             self.io.spawn(self._flush_task_events_loop())
@@ -220,32 +244,37 @@ class CoreWorker:
 
     # ------------------------------------------------------- task events
     def emit_task_event(self, spec: TaskSpec, state: str,
-                        error: Optional[str] = None) -> None:
+                        error: Optional[str] = None,
+                        ts: Optional[float] = None) -> None:
         """Record one lifecycle transition; cheap append, flushed async."""
         if not RayConfig.task_events_enabled:
             return
+        aid = spec.actor_id or spec.actor_creation_id
         ev = {
             "task_id": spec.task_id.hex(),
             "attempt": spec.attempt_number,
             "name": spec.name,
             "state": state,
-            "ts": time.time(),
+            "ts": ts if ts is not None else time.time(),
             "job_id": spec.job_id.hex(),
             "type": spec.task_type.name,
-            "actor_id": (spec.actor_id or spec.actor_creation_id).hex()
-            if (spec.actor_id or spec.actor_creation_id) else None,
-            "node_id": self.node_id.hex() if self.node_id else None,
-            "worker_id": self.worker_id.hex(),
-            "pid": os.getpid(),
+            "actor_id": aid.hex() if aid else None,
+            "node_id": self._node_id_hex,
+            "worker_id": self._worker_id_hex,
+            "pid": self._pid,
         }
         if error:
             ev["error"] = error[:500]
         self._task_events.append(ev)
-        if state in ("FINISHED", "FAILED"):
+        if state in ("FINISHED", "FAILED") and not self._flush_scheduled:
             # Terminal events flush eagerly: a worker reused for the next task
             # may be killed by it before the periodic tick, losing this task's
-            # whole lifecycle from the state API.
-            self.io.spawn(self._flush_task_events())
+            # whole lifecycle from the state API.  One pending flush is
+            # enough — under a burst of completions the first drain takes
+            # everything queued behind it (a spawn per task costs a
+            # cross-thread wakeup each).
+            self._flush_scheduled = True
+            self.io.spawn(self._flush_task_events_once())
 
     async def _push_metrics_loop(self):
         """Push this worker's metrics (built-in + user-defined via
@@ -281,6 +310,10 @@ class CoreWorker:
         while not self._shut:
             await asyncio.sleep(interval)
             await self._flush_task_events()
+
+    async def _flush_task_events_once(self):
+        self._flush_scheduled = False
+        await self._flush_task_events()
 
     async def _flush_task_events(self):
         if not self._task_events:
@@ -847,15 +880,28 @@ class CoreWorker:
 
     # ========================================================= task submission
     def _function_payload(self, fn) -> Tuple[Optional[bytes], Optional[str]]:
-        blob = cloudpickle.dumps(fn)
-        if len(blob) <= _FUNCTION_TABLE_THRESHOLD:
-            return blob, None
-        key = "fn:" + hashlib.sha1(blob).hexdigest()
-        if key not in self._pushed_fns:
-            self.io.run(self.gcs_conn.call("kv_put", {
-                "ns": "fn", "key": key, "value": blob, "overwrite": False}))
-            self._pushed_fns.add(key)
-        return None, key
+        # Cache per function object: re-cloudpickling an unchanged function on
+        # every `.remote()` cost ~0.4ms/call and dominated the submit path.
+        # Pickling once also matches the reference's capture-at-decoration
+        # semantics (remote_function.py pickles when @ray.remote runs).
+        ent = self._fn_payload_cache.get(fn)
+        if ent is None:
+            blob = cloudpickle.dumps(fn)
+            if len(blob) <= _FUNCTION_TABLE_THRESHOLD:
+                ent = (blob, None)
+            else:
+                ent = (None, "fn:" + hashlib.sha1(blob).hexdigest())
+                key = ent[1]
+                if key not in self._pushed_fns:
+                    self.io.run(self.gcs_conn.call("kv_put", {
+                        "ns": "fn", "key": key, "value": blob,
+                        "overwrite": False}))
+                    self._pushed_fns.add(key)
+            try:
+                self._fn_payload_cache[fn] = ent
+            except TypeError:
+                pass  # unweakrefable callable: just re-pickle next time
+        return ent
 
     def _build_args(self, args, kwargs) -> Tuple[List[Any], List[str], List[ObjectRef]]:
         """Serialize call arguments (reference: dependency_resolver.h inlining +
@@ -904,7 +950,7 @@ class CoreWorker:
             self.memory_store.register_pending(oid)
             refs.append(ObjectRef(oid, self.addr, self.worker_id.binary()))
         self.emit_task_event(spec, "SUBMITTED")
-        self.io.spawn(self.submitter.submit(spec, holds))
+        self.submitter.enqueue(spec, holds)
         return refs
 
     # ------------------------------------------------------------- actors
@@ -961,7 +1007,7 @@ class CoreWorker:
             self.memory_store.register_pending(oid)
             refs.append(ObjectRef(oid, self.addr, self.worker_id.binary()))
         self.emit_task_event(spec, "SUBMITTED")
-        self.io.spawn(self._actor_submitter(actor_id).submit(spec, holds))
+        self._actor_submitter(actor_id).enqueue(spec, holds)
         return refs
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
@@ -1101,9 +1147,44 @@ class CoreWorker:
         max_concurrency (reference: actor_scheduling_queue.h).  Normal tasks:
         bounded by the lease pipeline depth (see __init__); actor CREATION
         still runs inline so the actor exists before its first method call."""
+        held = None
         while True:
-            item = await self._exec_queue.get()
+            if held is not None:
+                item, held = held, None
+            else:
+                item = await self._exec_queue.get()
             spec, reply_fut = item
+            if self._actor_sem is None and spec.task_type == TaskType.ACTOR_TASK:
+                # Plain sync actor (no concurrency): run every consecutive
+                # queued sync method in ONE executor hop.  The loop->actor-
+                # thread->loop round trip per call (~hundreds of us on a
+                # shared core) was the throughput cap for sync actors; the
+                # chunk completes in one tick so its result notifies coalesce
+                # into one frame too.
+                method = None
+                if self.actor_instance is not None:
+                    method = getattr(
+                        self.actor_instance, spec.actor_method_name, None)
+                if method is not None and not asyncio.iscoroutinefunction(method):
+                    chunk = [(spec, reply_fut, method)]
+                    while len(chunk) < 256:
+                        try:
+                            nspec, nfut = self._exec_queue.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
+                        nmethod = None
+                        if nspec.task_type == TaskType.ACTOR_TASK:
+                            nmethod = getattr(
+                                self.actor_instance, nspec.actor_method_name,
+                                None)
+                        if nmethod is not None and \
+                                not asyncio.iscoroutinefunction(nmethod):
+                            chunk.append((nspec, nfut, nmethod))
+                        else:
+                            held = (nspec, nfut)
+                            break
+                    await self._run_chunk(chunk)
+                    continue
             if self._actor_sem is not None:
                 await self._actor_sem.acquire()
                 asyncio.get_event_loop().create_task(self._run_one(spec, reply_fut, release=True))
@@ -1112,21 +1193,146 @@ class CoreWorker:
                 if spec.runtime_env:
                     # env application mutates process-global state
                     # (os.environ, cwd, sys.path): run EXCLUSIVELY by
-                    # draining every pipeline permit first
-                    depth = max(RayConfig.lease_pipeline_depth, 1)
-                    for _ in range(depth):
+                    # draining every executor permit first
+                    permits = self._task_permits
+                    for _ in range(permits):
                         await self._task_sem.acquire()
                     try:
                         await self._run_one(spec, reply_fut, release=False)
                     finally:
-                        for _ in range(depth):
+                        for _ in range(permits):
                             self._task_sem.release()
                 else:
                     await self._task_sem.acquire()
+                    # Chunk the burst: every consecutive queued env-free
+                    # normal task shares ONE permit/thread/executor hop and
+                    # completes on one tick (so result notifies coalesce).
+                    # A blocking task stalls only its chunk-mates — still
+                    # strictly more concurrent than the reference's
+                    # one-task-at-a-time worker; the remaining permits keep
+                    # serving later chunks in parallel.
+                    chunk = [(spec, reply_fut)]
+                    while len(chunk) < 64:
+                        try:
+                            nspec, nfut = self._exec_queue.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
+                        if nspec.task_type == TaskType.NORMAL_TASK and \
+                                not nspec.runtime_env:
+                            chunk.append((nspec, nfut))
+                        else:
+                            held = (nspec, nfut)
+                            break
                     asyncio.get_event_loop().create_task(
-                        self._run_one(spec, reply_fut, release_task=True))
+                        self._run_normal_chunk(chunk))
             else:
                 await self._run_one(spec, reply_fut, release=False)
+
+    def _complete_chunk_item(self, spec: TaskSpec, fut, result: dict) -> None:
+        """Per-task completion for chunked execution (runs on the IO loop;
+        the done-buffer coalesces same-tick completions into one frame)."""
+        if result.get("status") == "ok":
+            self.emit_task_event(spec, "FINISHED")
+        elif RayConfig.task_events_enabled:
+            err_repr = None
+            if result.get("error"):
+                try:
+                    err_repr = repr(pickle.loads(result["error"]))
+                except Exception:  # an unpicklable user error must not kill
+                    err_repr = "<error not unpicklable>"  # the loop
+            self.emit_task_event(spec, "FAILED", error=err_repr)
+        if not fut.done():
+            fut.set_result(result)
+
+    def _run_spec_chunk_sync(self, chunk, invoke) -> None:
+        """Body shared by actor/normal chunked execution: runs on ONE
+        executor thread; each task's completion is delivered to the loop as
+        it finishes, so a slow task never delays the results of the tasks
+        that ran before it."""
+        loop = self.io.loop
+        for item in chunk:
+            spec, fut = item[0], item[1]
+            started = time.time()
+            # Emitted from the executor thread at actual start (deque.append
+            # is thread-safe) so a hung task is visible as RUNNING in the
+            # state API, not stuck at SUBMITTED.
+            self.emit_task_event(spec, "RUNNING", ts=started)
+            try:
+                result = invoke(item)
+            except BaseException as e:  # never kill the chunk
+                result = {"status": "error", "error": pickle.dumps(
+                    RayTaskError.from_exception(spec.name, e))}
+            loop.call_soon_threadsafe(
+                self._complete_chunk_item, spec, fut, result)
+
+    async def _run_chunk(self, chunk) -> None:
+        """Execute consecutive sync actor methods in one executor call."""
+        loop = asyncio.get_event_loop()
+        await loop.run_in_executor(
+            self.executor_pool, self._run_spec_chunk_sync, chunk,
+            lambda item: self._invoke_sync(item[0], item[2]))
+
+    # A normal-task chunk whose current item runs longer than this has its
+    # not-yet-started tail stolen onto another thread, so a task that blocks
+    # (e.g. on a nested get, or waiting for a signal sent by a chunk-mate
+    # queued behind it) can never wedge the tasks packed after it.
+    _CHUNK_STALL_STEAL_S = 0.1
+
+    async def _run_normal_chunk(self, chunk) -> None:
+        """Run consecutive env-free normal tasks on one executor thread,
+        holding one pipeline permit for the whole chunk."""
+        loop = asyncio.get_event_loop()
+        run = {"items": chunk, "next": 0, "cur_start": None, "done": False}
+        lock = threading.Lock()
+
+        def body():
+            while True:
+                with lock:
+                    if run["next"] >= len(run["items"]):
+                        return
+                    item = run["items"][run["next"]]
+                    run["next"] += 1
+                    run["cur_start"] = time.monotonic()
+                spec, fut = item
+                # thread-safe deque append: RUNNING is visible while the
+                # task executes, not backdated at completion
+                self.emit_task_event(spec, "RUNNING")
+                try:
+                    result = self._invoke_normal_sync(spec)
+                except BaseException as e:  # never kill the chunk
+                    result = {"status": "error", "error": pickle.dumps(
+                        RayTaskError.from_exception(spec.name, e))}
+                loop.call_soon_threadsafe(
+                    self._complete_chunk_item, spec, fut, result)
+
+        def watchdog():
+            if run["done"]:
+                return
+            steal = None
+            with lock:
+                cs = run["cur_start"]
+                if cs is not None and \
+                        time.monotonic() - cs > self._CHUNK_STALL_STEAL_S and \
+                        run["next"] < len(run["items"]):
+                    steal = run["items"][run["next"]:]
+                    run["items"] = run["items"][:run["next"]]
+            if steal:
+                loop.create_task(self._respawn_chunk(steal))
+                return  # nothing left to guard
+            loop.call_later(self._CHUNK_STALL_STEAL_S, watchdog)
+
+        loop.call_later(self._CHUNK_STALL_STEAL_S, watchdog)
+        try:
+            await loop.run_in_executor(self.executor_pool, body)
+        finally:
+            run["done"] = True
+            if self._task_sem is not None:
+                self._task_sem.release()
+
+    async def _respawn_chunk(self, chunk) -> None:
+        """Continue a stolen chunk tail under its own permit/thread."""
+        await self._task_sem.acquire()
+        await self._run_normal_chunk(chunk)
 
     async def _run_one(self, spec: TaskSpec, reply_fut: asyncio.Future,
                        release: bool = False, release_task: bool = False):
@@ -1163,9 +1369,83 @@ class CoreWorker:
         await self._exec_queue.put((spec, reply_fut))
         return await reply_fut
 
+    async def rpc_push_task_batch(self, conn, payload):
+        """One-way batched task push: N specs in one frame; each completion
+        flows back as a coalesced ``tasks_done`` notify on the same
+        connection.  This is the hot submission path — the request/response
+        ``push_task`` costs two frames and an asyncio task per call, which
+        caps a pure-Python control plane far below the reference's C++ core
+        (reference: batched lease pipelining in NormalTaskSubmitter,
+        transport/normal_task_submitter.h:75)."""
+        specs: List[TaskSpec] = pickle.loads(payload)
+        loop = asyncio.get_event_loop()
+        for spec in specs:
+            reply_fut = loop.create_future()
+            reply_fut.add_done_callback(
+                lambda f, s=spec: self._buffer_done(conn, s, f))
+            await self._exec_queue.put((spec, reply_fut))
+
+    def _buffer_done(self, conn, spec: TaskSpec, fut) -> None:
+        try:
+            result = dict(fut.result())
+        except BaseException as e:  # never lose a completion
+            result = {"status": "error", "error": pickle.dumps(
+                RayTaskError.from_exception(spec.name, e))}
+        result["task_id"] = spec.task_id.binary()
+        buf = self._done_buf.get(conn)
+        if buf is None:
+            self._done_buf[conn] = [result]
+            asyncio.get_event_loop().call_soon(self._flush_done, conn)
+        else:
+            buf.append(result)
+
+    def _flush_done(self, conn) -> None:
+        items = self._done_buf.pop(conn, None)
+        if not items or conn.closed:
+            return
+
+        async def _send():
+            try:
+                await conn.notify("tasks_done", items)
+            except (ConnectionError, rpc.ConnectionLost):
+                pass  # caller died; its inflight map dies with it
+
+        asyncio.get_event_loop().create_task(_send())
+
+    async def rpc_tasks_done(self, conn, items):
+        """Submitter side of the batched path: route each completed item to
+        the callback registered at send time."""
+        tset = self._conn_tasks.get(conn)
+        for item in items:
+            tkey = item["task_id"]
+            if tset is not None:
+                tset.discard(tkey)
+            cb = self._completion_router.pop(tkey, None)
+            if cb is not None:
+                cb(item)
+
+    def _on_worker_conn_lost(self, conn) -> None:
+        """A pooled worker connection died: deliver a synthetic 'lost' item
+        to every normal task that was inflight on it (runs on the IO loop)."""
+        for tkey in self._conn_tasks.pop(conn, ()):
+            cb = self._completion_router.pop(tkey, None)
+            if cb is not None:
+                cb({"task_id": tkey, "status": "lost"})
+
     def _load_function(self, spec: TaskSpec):
         if spec.function_blob is not None:
-            return cloudpickle.loads(spec.function_blob)
+            # Cache by blob bytes: a submitter pickles its function once, so
+            # repeated tasks carry an identical blob — un-pickling it per
+            # task cost ~0.3ms/call on noop storms.  Bounded: a driver
+            # minting fresh closures per submission must not grow a
+            # long-lived worker without limit.
+            fn = self._fn_cache.get(spec.function_blob)
+            if fn is None:
+                fn = cloudpickle.loads(spec.function_blob)
+                if len(self._fn_cache) >= 512:
+                    self._fn_cache.clear()
+                self._fn_cache[spec.function_blob] = fn
+            return fn
         key = spec.function_key
         fn = self._fn_cache.get(key)
         if fn is None:
@@ -1381,6 +1661,65 @@ class NormalTaskSubmitter:
         self.cw = cw
         self.classes: Dict[tuple, dict] = {}
         self._pg_node_cache: Dict[bytes, Tuple[float, dict]] = {}
+        # Staged submissions: `.remote()` appends here from the caller's
+        # thread; one IO-loop wakeup drains the whole burst (mirrors
+        # ActorTaskSubmitter.enqueue).
+        self._stage: deque = deque()
+        self._stage_lock = threading.Lock()
+        self._stage_scheduled = False
+
+    # ------------------------------------------------------- staged enqueue
+    def enqueue(self, spec: TaskSpec, holds) -> None:
+        """Called from any thread.  At most one IO-loop wakeup per burst."""
+        with self._stage_lock:
+            self._stage.append((spec, holds))
+            if self._stage_scheduled:
+                return
+            self._stage_scheduled = True
+        self.cw.io.loop.call_soon_threadsafe(self._start_stage_drain)
+
+    def _start_stage_drain(self) -> None:
+        asyncio.get_event_loop().create_task(self._drain_stage())
+
+    def _has_pending_deps(self, spec: TaskSpec) -> bool:
+        ms = self.cw.memory_store
+        my_id = self.cw.worker_id.binary()
+        for a in spec.args:
+            if isinstance(a, RefArg) and a.owner_worker_id == my_id and \
+                    ms.known(a.object_id) and not ms.contains(a.object_id):
+                return True
+        return False
+
+    async def _drain_stage(self) -> None:
+        loop = asyncio.get_event_loop()
+        while True:
+            with self._stage_lock:
+                items = list(self._stage)
+                self._stage.clear()
+                if not items:
+                    self._stage_scheduled = False
+                    return
+            touched: Dict[tuple, dict] = {}
+            for spec, holds in items:
+                if self._has_pending_deps(spec):
+                    # The dep may be produced by a task staged BEHIND this
+                    # one (or pumped only below): waiting inline would
+                    # deadlock the drainer — and with it every later
+                    # submission in the process.
+                    loop.create_task(self.submit(spec, holds))
+                    continue
+                try:
+                    await self._resolve_local_deps(spec)
+                except BaseException as e:
+                    self.cw.fail_task(spec, RaySystemError(
+                        f"dependency resolution failed: {e!r}"), holds)
+                    continue
+                key = spec.scheduling_class()
+                st = self._class(key)
+                st["pending"].append((spec, holds))
+                touched[key] = st
+            for key, st in touched.items():
+                await self._pump(key, st)
 
     def _class(self, key) -> dict:
         st = self.classes.get(key)
@@ -1450,8 +1789,7 @@ class NormalTaskSubmitter:
                 # worker processes beats even spreading (saturated leases drop
                 # out of idle, so overflow spills to the next worker anyway)
                 st["idle"].append(lease)
-            asyncio.get_event_loop().create_task(
-                self._push_one(key, st, spec, holds, lease))
+            self._queue_push(key, st, spec, holds, lease)
         # Lease-request parallelism beyond the host's cores only buys process
         # churn: every granted lease is a worker process contending for the
         # same CPUs (the config cap still bounds big hosts).
@@ -1643,28 +1981,73 @@ class NormalTaskSubmitter:
     async def _worker_conn(self, addr) -> rpc.Connection:
         conn = self.cw._worker_conns.get(tuple(addr))
         if conn is None or conn.closed:
-            conn = await rpc.connect(*addr, name=f"->worker-{addr[1]}")
+            conn = await rpc.connect(*addr, name=f"->worker-{addr[1]}",
+                                     handlers=self.cw._rpc_handlers)
+            conn._on_close = self.cw._on_worker_conn_lost
             self.cw._worker_conns[tuple(addr)] = conn
+            if conn.closed:
+                # dropped in the attach window: the callback never re-fires
+                self.cw._on_worker_conn_lost(conn)
         return conn
 
-    async def _push_one(self, key, st, spec: TaskSpec, holds, lease):
+    # Batched dispatch: specs dispatched to the same lease within one loop
+    # tick ride ONE push_task_batch frame; completions come back as coalesced
+    # tasks_done notifies (see CoreWorker.rpc_push_task_batch).  The previous
+    # call-per-task design cost two frames plus an asyncio task per task,
+    # which capped async task throughput at ~11% of the reference baseline.
+    def _queue_push(self, key, st, spec: TaskSpec, holds, lease) -> None:
         st["busy"] += 1
-        worker_ok = True
+        buf = lease.get("outbuf")
+        if buf is None:
+            lease["outbuf"] = [(spec, holds)]
+            asyncio.get_event_loop().create_task(
+                self._flush_push(key, st, lease))
+        else:
+            buf.append((spec, holds))
+
+    async def _flush_push(self, key, st, lease) -> None:
+        items = lease.pop("outbuf", None)
+        if not items:
+            return
+        conn = lease["worker_conn"]
+        if conn.closed:
+            for spec, holds in items:
+                self._normal_done(key, st, lease, spec, holds,
+                                  {"status": "lost"})
+            return
+        for spec, holds in items:
+            tkey = spec.task_id.binary()
+            self.cw._completion_router[tkey] = (
+                lambda item, s=spec, h=holds:
+                self._normal_done(key, st, lease, s, h, item))
+            self.cw._conn_tasks.setdefault(conn, set()).add(tkey)
         try:
-            reply = await lease["worker_conn"].call("push_task", pickle.dumps(spec), timeout=None)
-            if reply["status"] == "ok":
-                self.cw.complete_task(spec, reply["returns"], holds)
+            await conn.notify("push_task_batch",
+                              pickle.dumps([s for s, _ in items]))
+        except (rpc.ConnectionLost, ConnectionError):
+            # the close callback (or this sweep, if it already ran) delivers
+            # synthetic 'lost' items for everything registered above
+            self.cw._on_worker_conn_lost(conn)
+
+    def _normal_done(self, key, st, lease, spec: TaskSpec, holds,
+                     item: dict) -> None:
+        """Completion for one batched normal task (runs on the IO loop)."""
+        worker_ok = True
+        if item["status"] == "ok":
+            self.cw.complete_task(spec, item["returns"], holds)
+        elif item["status"] == "error":
+            retriable = False
+            if spec.retry_exceptions and spec.attempt_number < spec.max_retries:
+                retriable = True
+            if retriable:
+                spec.attempt_number += 1
+                self.cw.emit_task_event(spec, "SUBMITTED")
+                st["pending"].append((spec, holds))
             else:
-                err = pickle.loads(reply["error"])
-                if spec.retry_exceptions and spec.attempt_number < spec.max_retries:
-                    spec.attempt_number += 1
-                    self.cw.emit_task_event(spec, "SUBMITTED")
-                    st["pending"].append((spec, holds))
-                else:
-                    self.cw.complete_task(
-                        spec, [(oid.binary(), "error", reply["error"])
-                               for oid in spec.return_ids()], holds)
-        except (rpc.ConnectionLost, ConnectionError) as e:
+                self.cw.complete_task(
+                    spec, [(oid.binary(), "error", item["error"])
+                           for oid in spec.return_ids()], holds)
+        else:  # "lost": the worker connection died mid-task
             worker_ok = False
             if spec.attempt_number < spec.max_retries:
                 spec.attempt_number += 1
@@ -1674,23 +2057,44 @@ class NormalTaskSubmitter:
                 st["pending"].append((spec, holds))
             else:
                 self.cw.fail_task(spec, WorkerCrashedError(
-                    f"worker died while running task {spec.name}: {e}"), holds)
-        finally:
-            st["busy"] -= 1
-            lease["inflight"] = max(lease.get("inflight", 1) - 1, 0)
-            if worker_ok and not lease.get("returned") \
-                    and not any(l is lease for l in st["idle"]):
-                st["idle"].append(lease)
-            elif not worker_ok and any(l is lease for l in st["idle"]):
-                st["idle"] = [l for l in st["idle"] if l is not lease]
+                    f"worker died while running task {spec.name}"), holds)
+        st["busy"] -= 1
+        lease["inflight"] = max(lease.get("inflight", 1) - 1, 0)
+        if worker_ok and not lease.get("returned") \
+                and not any(l is lease for l in st["idle"]):
+            st["idle"].append(lease)
+        elif not worker_ok and any(l is lease for l in st["idle"]):
+            st["idle"] = [l for l in st["idle"] if l is not lease]
+        self._schedule_pump(key, st)
+
+    def _schedule_pump(self, key, st) -> None:
+        """Coalesce pump wakeups: one per burst of completions, not one per
+        task."""
+        if st.get("pump_scheduled"):
+            return
+        st["pump_scheduled"] = True
+
+        async def _p():
+            st["pump_scheduled"] = False
             await self._pump(key, st)
+
+        asyncio.get_event_loop().create_task(_p())
 
 
 class ActorTaskSubmitter:
     """Direct actor-task submission over one persistent connection
     (reference: transport/actor_task_submitter.h:73).  Ordering: one TCP stream +
     in-order dispatch on the actor side replaces explicit sequence numbers for
-    the common path; retries after restart re-enter the queue in order."""
+    the common path; retries after restart re-enter the queue in order.
+
+    Submission is BATCHED: ``.remote()`` (any thread) appends the spec to a
+    queue and wakes the IO loop at most once per burst; the drain coroutine
+    ships every queued spec in one ``push_task_batch`` frame, and completions
+    return as coalesced one-way ``tasks_done`` notifies routed through
+    ``CoreWorker._completion_router``.  This amortizes the two costs that
+    dominated the per-call design — the cross-thread wakeup per ``.remote()``
+    and the two frames + asyncio task per call — which held async actor
+    throughput to ~20% of the reference's C++ core."""
 
     def __init__(self, cw: CoreWorker, actor_id: ActorID):
         self.cw = cw
@@ -1702,6 +2106,106 @@ class ActorTaskSubmitter:
         self._connect_lock = asyncio.Lock()
         self._subscribed = False
         self._inflight: Dict[bytes, Tuple[TaskSpec, list]] = {}
+        # (spec, holds) waiting for the next drain; guarded by _queue_lock
+        # (appended from the caller's thread, drained on the IO loop).
+        self._queue: deque = deque()
+        self._queue_lock = threading.Lock()
+        self._drain_scheduled = False
+
+    # ------------------------------------------------------- enqueue / drain
+    def enqueue(self, spec: TaskSpec, holds) -> None:
+        """Called from any thread.  At most one IO-loop wakeup per burst."""
+        with self._queue_lock:
+            self._queue.append((spec, holds))
+            if self._drain_scheduled:
+                return
+            self._drain_scheduled = True
+        self.cw.io.loop.call_soon_threadsafe(self._start_drain)
+
+    def _start_drain(self) -> None:
+        asyncio.get_event_loop().create_task(self._drain())
+
+    async def _drain(self) -> None:
+        while True:
+            with self._queue_lock:
+                items = list(self._queue)
+                self._queue.clear()
+                if not items:
+                    self._drain_scheduled = False
+                    return
+            try:
+                await self._ensure_connected()
+            except (RayActorError, ActorDiedError) as e:
+                for spec, holds in items:
+                    self.cw.fail_task(spec, e, holds)
+                continue
+            except (rpc.ConnectionLost, ConnectionError):
+                # connection dropped in the attach window: requeue in order
+                # and retry (ensure_connected paces the loop via the GCS
+                # wait_alive round-trip)
+                with self._queue_lock:
+                    self._queue.extendleft(reversed(items))
+                continue
+            for spec, holds in items:
+                tkey = spec.task_id.binary()
+                self._inflight[tkey] = (spec, holds)
+                self.cw._completion_router[tkey] = (
+                    lambda item, s=spec, h=holds: self._complete(s, h, item))
+            conn = self.conn
+            try:
+                await conn.notify(
+                    "push_task_batch",
+                    pickle.dumps([spec for spec, _ in items]))
+            except (rpc.ConnectionLost, ConnectionError):
+                # the close callback retries/fails every inflight (incl. this
+                # batch); nothing more to do here
+                self._on_conn_lost(conn)
+
+    def _complete(self, spec: TaskSpec, holds, item: dict) -> None:
+        tkey = spec.task_id.binary()
+        if self._inflight.pop(tkey, None) is None:
+            return  # already failed via death notification
+        if item["status"] == "ok":
+            self.cw.complete_task(spec, item["returns"], holds)
+        else:
+            self.cw.complete_task(
+                spec, [(oid.binary(), "error", item["error"])
+                       for oid in spec.return_ids()], holds)
+
+    # ------------------------------------------------------------- failures
+    def _on_conn_lost(self, conn) -> None:
+        """Runs on the IO loop when the actor connection drops.  Retry
+        eligible inflight tasks through the reconnect path (which waits for
+        the restart); fail the rest."""
+        if self.conn is not None and conn is not self.conn:
+            return  # stale: a newer connection is already active
+        # self.conn may already be None (RESTARTING pubsub beat the close
+        # event); the inflight sweep below must still run or those tasks
+        # would hang forever.
+        self.conn = None
+        retried = False
+        for tkey in list(self._inflight):
+            spec, holds = self._inflight.pop(tkey)
+            self.cw._completion_router.pop(tkey, None)
+            if spec.max_task_retries != 0 and \
+                    spec.attempt_number < max(spec.max_task_retries, 0):
+                spec.attempt_number += 1
+                with self._queue_lock:
+                    self._queue.append((spec, holds))
+                retried = True
+            else:
+                self.cw.fail_task(spec, ActorDiedError(
+                    self.actor_id,
+                    f"actor {self.actor_id.hex()[:8]} died while running {spec.name}"),
+                    holds)
+        if retried:
+            with self._queue_lock:
+                if self._drain_scheduled:
+                    retried = False
+                else:
+                    self._drain_scheduled = True
+            if retried:
+                self._start_drain()
 
     def _on_actor_update(self, info):
         self.state = info["state"]
@@ -1710,6 +2214,12 @@ class ActorTaskSubmitter:
             err = ActorDiedError(self.actor_id, _actor_death_msg(self.actor_id, self.death_cause))
             for task_key in list(self._inflight):
                 spec, holds = self._inflight.pop(task_key)
+                self.cw._completion_router.pop(task_key, None)
+                self.cw.fail_task(spec, err, holds)
+            with self._queue_lock:
+                queued = list(self._queue)
+                self._queue.clear()
+            for spec, holds in queued:
                 self.cw.fail_task(spec, err, holds)
             self.conn = None
         elif info["state"] in ("RESTARTING",):
@@ -1737,41 +2247,19 @@ class ActorTaskSubmitter:
                     raise ActorDiedError(
                         self.actor_id, _actor_death_msg(self.actor_id, info.get("death_cause", "")))
                 if info["state"] == "ALIVE" and info["addr"]:
-                    self.conn = await rpc.connect(
-                        *info["addr"], name=f"->actor-{self.actor_id.hex()[:6]}")
+                    conn = await rpc.connect(
+                        *info["addr"], name=f"->actor-{self.actor_id.hex()[:6]}",
+                        handlers=self.cw._rpc_handlers)
+                    conn._on_close = self._on_conn_lost
+                    self.conn = conn
+                    if conn.closed:
+                        # dropped in the attach window: the callback never
+                        # re-fires for an already-closed connection
+                        self._on_conn_lost(conn)
+                        raise rpc.ConnectionLost("actor connection dropped")
                     return
                 if time.monotonic() > deadline:
                     raise RayActorError(self.actor_id, "timed out waiting for actor to start")
-
-    async def submit(self, spec: TaskSpec, holds):
-        tkey = spec.task_id.binary()
-        try:
-            await self._ensure_connected()
-            self._inflight[tkey] = (spec, holds)
-            reply = await self.conn.call("push_task", pickle.dumps(spec), timeout=None)
-            if tkey not in self._inflight:
-                return  # already failed via death notification
-            del self._inflight[tkey]
-            if reply["status"] == "ok":
-                self.cw.complete_task(spec, reply["returns"], holds)
-            else:
-                self.cw.complete_task(
-                    spec, [(oid.binary(), "error", reply["error"])
-                           for oid in spec.return_ids()], holds)
-        except (rpc.ConnectionLost, ConnectionError):
-            self._inflight.pop(tkey, None)
-            self.conn = None
-            if spec.max_task_retries != 0 and spec.attempt_number < max(spec.max_task_retries, 0):
-                spec.attempt_number += 1
-                await self.submit(spec, holds)
-            else:
-                self.cw.fail_task(spec, ActorDiedError(
-                    self.actor_id,
-                    f"actor {self.actor_id.hex()[:8]} died while running {spec.name}"),
-                    holds)
-        except (RayActorError, ActorDiedError) as e:
-            self._inflight.pop(tkey, None)
-            self.cw.fail_task(spec, e, holds)
 
 
 def _actor_death_msg(actor_id: ActorID, cause: str) -> str:

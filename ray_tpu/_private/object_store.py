@@ -31,8 +31,10 @@ Server-side methods are synchronous and only called from the nodelet's event loo
 
 from __future__ import annotations
 
+import collections
 import logging
 import os
+import threading
 import time
 from multiprocessing import resource_tracker, shared_memory
 from typing import Dict, List, Optional, Set, Tuple
@@ -72,19 +74,26 @@ def _attach_shm(name: str) -> shared_memory.SharedMemory:
 
 class _Entry:
     __slots__ = (
-        "oid", "shm", "size", "sealed", "pins", "last_access",
-        "is_primary", "spilled_path",
+        "oid", "shm", "size", "alloc", "sealed", "pins", "last_access",
+        "is_primary", "spilled_path", "ever_viewed",
     )
 
-    def __init__(self, oid: ObjectID, shm: Optional[shared_memory.SharedMemory], size: int, is_primary: bool):
+    def __init__(self, oid: ObjectID, shm: Optional[shared_memory.SharedMemory], size: int, is_primary: bool,
+                 alloc: Optional[int] = None):
         self.oid = oid
         self.shm = shm
         self.size = size
+        self.alloc = alloc if alloc is not None else size  # segment bytes
         self.sealed = False
         self.pins = 0  # outstanding client pins; only 0-pin objects evict
         self.last_access = time.monotonic()
         self.is_primary = is_primary  # created locally by owner (vs pulled copy)
         self.spilled_path: Optional[str] = None
+        # True once ANY reader (client mapping or server-side view) may have
+        # aliased the segment.  Such segments must be unlinked, never pooled:
+        # a lingering zero-copy view must keep seeing the old bytes (plasma's
+        # pin-until-last-view contract).
+        self.ever_viewed = False
 
 
 class PlasmaStore:
@@ -103,6 +112,53 @@ class PlasmaStore:
         self.on_deleted = None
         self.num_spilled = 0
         self.bytes_spilled = 0
+        # Segment pool: freed never-viewed segments keyed by allocation
+        # bucket, kept MAPPED so their pages stay physically allocated.  A
+        # fresh 64 MiB segment costs ~90 ms of first-touch page faults on
+        # write; a pooled one writes at memcpy speed.  This is the per-object
+        #-segment equivalent of the reference's one-arena dlmalloc design
+        # (plasma/plasma_allocator.cc), where pages are faulted once per
+        # store lifetime.
+        self._seg_pool: Dict[int, List[shared_memory.SharedMemory]] = {}
+        self._pool_bytes = 0
+        self._pool_cap = min(256 * 1024 * 1024, capacity_bytes // 4)
+
+    # Segments below this aren't pooled: their first-touch cost is trivial
+    # and page-rounding would distort small-capacity accounting.
+    _POOL_MIN_SEGMENT = 1024 * 1024
+
+    @classmethod
+    def _bucket(cls, size: int) -> int:
+        """Round poolable allocations to whole pages; repeated puts of
+        same-shaped payloads (the common steady-state) then land in matching
+        buckets."""
+        if size < cls._POOL_MIN_SEGMENT:
+            return max(size, 1)
+        return (size + 4095) & ~4095
+
+    def _pool_take(self, bucket: int) -> Optional[shared_memory.SharedMemory]:
+        pool = self._seg_pool.get(bucket)
+        if pool:
+            self._pool_bytes -= bucket
+            return pool.pop()
+        return None
+
+    def _pool_reclaim(self, need: int) -> None:
+        """Unlink pooled segments (largest first) to free real memory."""
+        freed = 0
+        for bucket in sorted(self._seg_pool, reverse=True):
+            pool = self._seg_pool[bucket]
+            while pool and freed < need:
+                shm = pool.pop()
+                self._pool_bytes -= bucket
+                freed += bucket
+                try:
+                    shm.unlink()
+                except FileNotFoundError:
+                    pass
+                shm.close()
+            if freed >= need:
+                break
 
     # -- helpers -------------------------------------------------------------
     def _segment_name(self) -> str:
@@ -116,11 +172,15 @@ class PlasmaStore:
         ]
 
     def _ensure_room(self, size: int) -> bool:
-        if self.used + size <= self.capacity:
+        if self.used + self._pool_bytes + size <= self.capacity:
+            return True
+        # Pooled (free but still-mapped) segments are the cheapest room.
+        self._pool_reclaim(self.used + self._pool_bytes + size - self.capacity)
+        if self.used + self._pool_bytes + size <= self.capacity:
             return True
         victims = sorted(self._evictable(), key=lambda e: e.last_access)
         for e in victims:
-            if self.used + size <= self.capacity:
+            if self.used + self._pool_bytes + size <= self.capacity:
                 break
             if e.is_primary:
                 if self.spill_dir:
@@ -128,49 +188,67 @@ class PlasmaStore:
                 # No spill dir: a primary copy is the ONLY copy — never delete
                 # it to make room; the create fails instead.
             else:
-                self._drop_shm(e)
+                # pool_ok=False: this eviction exists to FREE memory — moving
+                # the segment into the pool would make no progress and spill
+                # further victims for nothing.
+                self._drop_shm(e, pool_ok=False)
                 if not e.spilled_path:
                     del self.objects[e.oid]
                     if self.on_deleted:
                         self.on_deleted(e.oid)
-        return self.used + size <= self.capacity
+        self._pool_reclaim(self.used + self._pool_bytes + size - self.capacity)
+        return self.used + self._pool_bytes + size <= self.capacity
 
     def _spill(self, e: _Entry) -> None:
         os.makedirs(self.spill_dir, exist_ok=True)
         path = os.path.join(self.spill_dir, e.oid.hex())
         with open(path, "wb") as f:
-            f.write(e.shm.buf)
+            f.write(e.shm.buf[: e.size])
         e.spilled_path = path
         self.num_spilled += 1
         self.bytes_spilled += e.size
-        self._drop_shm(e)
+        # spilling exists to free memory: bypass the pool
+        self._drop_shm(e, pool_ok=False)
 
     def _restore(self, e: _Entry) -> None:
-        name = self._segment_name()
-        if not self._ensure_room(e.size):
-            raise ObjectStoreFullError(
-                f"cannot restore {e.oid}: store full ({self.used}/{self.capacity})"
-            )
-        shm = shared_memory.SharedMemory(name=name, create=True, size=max(e.size, 1))
+        alloc = self._bucket(e.size)
+        shm = self._pool_take(alloc)
+        if shm is None:
+            if not self._ensure_room(alloc):
+                raise ObjectStoreFullError(
+                    f"cannot restore {e.oid}: store full ({self.used}/{self.capacity})"
+                )
+            shm = shared_memory.SharedMemory(
+                name=self._segment_name(), create=True, size=alloc)
         with open(e.spilled_path, "rb") as f:
             f.readinto(shm.buf)
         e.shm = shm
-        self.used += e.size
+        e.alloc = alloc
+        e.ever_viewed = False
+        self.used += alloc
 
-    def _drop_shm(self, e: _Entry) -> None:
+    def _drop_shm(self, e: _Entry, pool_ok: bool = True) -> None:
         if e.shm is not None:
-            self.used -= e.size
-            try:
-                e.shm.unlink()
-            except FileNotFoundError:
-                pass
-            try:
-                e.shm.close()
-            except BufferError:
-                # A transient server-side view (push/spill in flight) still
-                # aliases the buffer; the segment is unlinked so the pages are
-                # reclaimed when the mapping dies with the view.
-                pass
+            self.used -= e.alloc
+            if pool_ok and not e.ever_viewed and \
+                    e.alloc >= self._POOL_MIN_SEGMENT and \
+                    self._pool_bytes + e.alloc <= self._pool_cap:
+                # Never aliased by a reader: safe to recycle with pages hot.
+                self._seg_pool.setdefault(e.alloc, []).append(e.shm)
+                self._pool_bytes += e.alloc
+            else:
+                try:
+                    e.shm.unlink()
+                except FileNotFoundError:
+                    pass
+                try:
+                    e.shm.close()
+                except BufferError:
+                    # A transient server-side view (push/spill in flight)
+                    # still aliases the buffer; the segment is unlinked so
+                    # the pages are reclaimed when the mapping dies with the
+                    # view.
+                    pass
             e.shm = None
 
     # -- API -----------------------------------------------------------------
@@ -187,17 +265,20 @@ class PlasmaStore:
             raise ObjectStoreFullError(
                 f"object of {size} bytes exceeds store capacity {self.capacity}"
             )
-        if not self._ensure_room(size):
-            raise ObjectStoreFullError(
-                f"store full: need {size}, used {self.used}/{self.capacity}, "
-                f"evictable {sum(x.size for x in self._evictable())}"
-            )
-        name = self._segment_name()
-        shm = shared_memory.SharedMemory(name=name, create=True, size=max(size, 1))
-        e = _Entry(oid, shm, size, is_primary)
+        alloc = self._bucket(size)
+        shm = self._pool_take(alloc)
+        if shm is None:
+            if not self._ensure_room(alloc):
+                raise ObjectStoreFullError(
+                    f"store full: need {size}, used {self.used}/{self.capacity}, "
+                    f"evictable {sum(x.size for x in self._evictable())}"
+                )
+            shm = shared_memory.SharedMemory(
+                name=self._segment_name(), create=True, size=alloc)
+        e = _Entry(oid, shm, size, is_primary, alloc=alloc)
         self.objects[oid] = e
-        self.used += size
-        return name
+        self.used += alloc
+        return shm.name
 
     def seal(self, oid: ObjectID) -> None:
         e = self.objects[oid]
@@ -217,6 +298,7 @@ class PlasmaStore:
         """Writable view of an unsealed entry (chunked transfer landing pad)."""
         e = self.objects[oid]
         assert not e.sealed, f"object {oid} already sealed"
+        e.ever_viewed = True  # returned view may outlive the entry
         return e.shm.buf
 
     def write_and_seal(self, oid: ObjectID, data: memoryview, is_primary: bool = True) -> None:
@@ -245,6 +327,7 @@ class PlasmaStore:
         if e.shm is None and e.spilled_path:
             self._restore(e)
         e.last_access = time.monotonic()
+        e.ever_viewed = True  # client maps by name: segment can't be pooled
         if pin:
             e.pins += 1
         return (e.shm.name, e.size)
@@ -257,6 +340,7 @@ class PlasmaStore:
         if e.shm is None and e.spilled_path:
             self._restore(e)
         e.last_access = time.monotonic()
+        e.ever_viewed = True  # returned view may outlive the entry
         return e.shm.buf[: e.size]
 
     def release(self, oid: ObjectID) -> None:
@@ -281,6 +365,7 @@ class PlasmaStore:
         return {
             "capacity": self.capacity,
             "used": self.used,
+            "pooled": self._pool_bytes,
             "num_objects": len(self.objects),
             "num_spilled": self.num_spilled,
             "bytes_spilled": self.bytes_spilled,
@@ -289,6 +374,7 @@ class PlasmaStore:
     def shutdown(self) -> None:
         for oid in list(self.objects):
             self.delete(oid)
+        self._pool_reclaim(self._pool_bytes)
 
 
 class PlasmaClient:
@@ -299,22 +385,81 @@ class PlasmaClient:
     plasma_store_provider.h:88; zero-copy get semantics of plasma).
     """
 
+    # Write-mapping cache budget: segment names recur when the store's pool
+    # recycles a segment; re-attaching costs a full round of soft page
+    # faults, so keeping the mapping makes repeated large puts run at
+    # memcpy speed.  Names are never reused for a different segment (the
+    # store's name sequence is monotonic), so a cached mapping is always
+    # the right inode.
+    _WRITE_CACHE_BYTES = 256 * 1024 * 1024
+
     def __init__(self, io, conn):
         # io: EventLoopThread, conn: Connection to the local nodelet
         self._io = io
         self._conn = conn
         self._mappings: Dict[ObjectID, shared_memory.SharedMemory] = {}
+        # name -> [shm, in_use_count]; LRU order.  Guarded by _write_lock:
+        # puts run concurrently on executor threads, and eviction must never
+        # close a mapping another thread is mid-write on (in_use > 0).
+        self._write_cache: "collections.OrderedDict[str, list]" = \
+            collections.OrderedDict()
+        self._write_cache_bytes = 0
+        self._write_lock = threading.Lock()
+
+    def _map_for_write(self, name: str) -> Tuple[shared_memory.SharedMemory, bool]:
+        """Returns (mapping, cached).  Cached mappings must be released via
+        _release_write (not closed); uncached ones are the caller's to
+        close."""
+        with self._write_lock:
+            ent = self._write_cache.get(name)
+            if ent is not None:
+                ent[1] += 1
+                self._write_cache.move_to_end(name)
+                return ent[0], True
+        shm = _attach_shm(name)
+        size = shm.size
+        if size > self._WRITE_CACHE_BYTES:
+            return shm, False
+        with self._write_lock:
+            if name in self._write_cache:  # raced with another thread
+                ent = self._write_cache[name]
+                ent[1] += 1
+                to_close = shm
+            else:
+                while self._write_cache_bytes + size > self._WRITE_CACHE_BYTES:
+                    victim = next((k for k, v in self._write_cache.items()
+                                   if v[1] == 0), None)
+                    if victim is None:
+                        break  # everything busy: run over budget briefly
+                    old = self._write_cache.pop(victim)
+                    self._write_cache_bytes -= old[0].size
+                    old[0].close()
+                self._write_cache[name] = [shm, 1]
+                self._write_cache_bytes += size
+                return shm, True
+        to_close.close()
+        return ent[0], True
+
+    def _release_write(self, name: str) -> None:
+        with self._write_lock:
+            ent = self._write_cache.get(name)
+            if ent is not None:
+                ent[1] = max(ent[1] - 1, 0)
 
     def put(self, oid: ObjectID, flat: memoryview | bytes) -> None:
         """Create + write + seal one object from an already-flat frame."""
         nbytes = flat.nbytes if isinstance(flat, memoryview) else len(flat)
-        shm = self._create(oid, nbytes)
-        if shm is None:
+        got = self._create(oid, nbytes)
+        if got is None:
             return
+        name, shm, cached = got
         try:
             shm.buf[:nbytes] = flat
         finally:
-            shm.close()
+            if cached:
+                self._release_write(name)
+            else:
+                shm.close()
         self._conn.call_sync("plasma_seal", {"oid": oid.binary()})
 
     def put_serialized(self, oid: ObjectID, ser) -> None:
@@ -322,13 +467,17 @@ class PlasmaClient:
         straight into the mapped segment — no intermediate flat copy (the
         to_bytes() round-trip doubles the memcpy cost of a large put)."""
         nbytes = ser.total_frame_bytes()
-        shm = self._create(oid, nbytes)
-        if shm is None:
+        got = self._create(oid, nbytes)
+        if got is None:
             return
+        name, shm, cached = got
         try:
             ser.write_into(shm.buf)
         finally:
-            shm.close()
+            if cached:
+                self._release_write(name)
+            else:
+                shm.close()
         self._conn.call_sync("plasma_seal", {"oid": oid.binary()})
 
     def _create(self, oid: ObjectID, nbytes: int):
@@ -345,7 +494,9 @@ class PlasmaClient:
                 time.sleep(RayConfig.object_store_full_delay_ms / 1000.0)
         if resp.get("exists"):
             return None
-        return _attach_shm(resp["name"])
+        name = resp["name"]
+        shm, cached = self._map_for_write(name)
+        return name, shm, cached
 
     def get_mapped(self, oid: ObjectID, timeout: Optional[float] = None) -> Optional[memoryview]:
         """Map a sealed object; returns a memoryview over shm or None on timeout.

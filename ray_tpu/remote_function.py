@@ -24,6 +24,15 @@ class RemoteFunction:
         self._function = fn
         self._default_options = merge_options(TASK_DEFAULTS, options)
         functools.update_wrapper(self, fn)
+        self._precompute()
+
+    def _precompute(self):
+        # Options are immutable per handle: derive the per-call submit
+        # arguments once instead of on every `.remote()` (hot path).
+        opts = self._default_options
+        self._resources = resources_from_options(opts)
+        self._strategy = strategy_from_options(opts)
+        self._call_name = opts["name"] or self._function.__qualname__
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -35,6 +44,7 @@ class RemoteFunction:
         new._function = self._function
         new._default_options = merge_options(self._default_options, task_options)
         functools.update_wrapper(new, self._function)
+        new._precompute()
         return new
 
     def bind(self, *args, **kwargs):
@@ -51,10 +61,10 @@ class RemoteFunction:
             self._function,
             args,
             kwargs,
-            name=opts["name"] or self._function.__qualname__,
+            name=self._call_name,
             num_returns=opts["num_returns"],
-            resources=resources_from_options(opts),
-            strategy=strategy_from_options(opts),
+            resources=dict(self._resources),
+            strategy=self._strategy,
             max_retries=opts["max_retries"],
             retry_exceptions=opts["retry_exceptions"],
             runtime_env=opts["runtime_env"],
