@@ -54,16 +54,25 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
     param_specs = jax.tree_util.tree_map(
         lambda _: P(axis), stacked_params)
 
-    def _smap(fn):
-        # jax >= 0.8 renamed check_rep -> check_vma
+    def _smap_variants(fn):
+        # Partial-manual shard_map (jax >= 0.8/0.9): ONLY the pp axis is
+        # manual, so dp/fsdp/tp/sp shardings of the activations stay under
+        # GSPMD and compose with the pipeline untouched.  Partial-manual is
+        # rejected outside jit (and by older jax), so a full-manual variant
+        # follows — correct when the other mesh axes carry no sharding.
         try:
-            return shard_map(fn, mesh=mesh, in_specs=(param_specs, P()),
-                             out_specs=P(), check_vma=False)
+            yield shard_map(fn, mesh=mesh, in_specs=(param_specs, P()),
+                            out_specs=P(), check_vma=False,
+                            axis_names={axis})
         except TypeError:
-            return shard_map(fn, mesh=mesh, in_specs=(param_specs, P()),
-                             out_specs=P(), check_rep=False)
+            pass
+        try:
+            yield shard_map(fn, mesh=mesh, in_specs=(param_specs, P()),
+                            out_specs=P(), check_vma=False)
+        except TypeError:
+            yield shard_map(fn, mesh=mesh, in_specs=(param_specs, P()),
+                            out_specs=P(), check_rep=False)
 
-    @_smap
     def run(params_local, xs):
         rank = jax.lax.axis_index(axis)
         stage_p = jax.tree_util.tree_map(lambda a: a[0], params_local)
@@ -93,4 +102,13 @@ def pipeline_apply(stage_fn: Callable, stacked_params, microbatches,
         # zero) copies replicates it without a separate broadcast.
         return jax.lax.psum(outs, axis)
 
-    return run(stacked_params, microbatches)
+    err = None
+    for mapped in _smap_variants(run):
+        try:
+            return mapped(stacked_params, microbatches)
+        except ValueError as e:
+            # partial-manual rejected (e.g. eager call outside jit): try the
+            # full-manual variant
+            err = e
+            continue
+    raise err
