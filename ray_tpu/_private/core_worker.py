@@ -1488,6 +1488,12 @@ class CoreWorker:
                 old_pool.shutdown(wait=False)
             return await loop.run_in_executor(self.executor_pool, self._create_actor_sync, spec)
         if spec.task_type == TaskType.ACTOR_TASK:
+            if spec.actor_method_name == "__ray_tpu_channel_loop__":
+                # compiled-DAG takeover (reference: compiled_dag_node actor
+                # loop): this task holds the actor and serves its node's
+                # shm channels until teardown closes them
+                return await loop.run_in_executor(
+                    self.executor_pool, self._run_channel_loop, spec)
             method = getattr(self.actor_instance, spec.actor_method_name, None)
             if self.actor_instance is None or method is None:
                 err = RayActorError(spec.actor_id,
@@ -1502,6 +1508,79 @@ class CoreWorker:
         # a blocking kv_get, which would deadlock if run on the IO loop.
         return await loop.run_in_executor(
             self.executor_pool, self._invoke_normal_sync, spec)
+
+    def _run_channel_loop(self, spec: TaskSpec) -> dict:
+        """Serve one compiled-DAG node: read input channels, run the bound
+        method, write every out-edge — no runtime involvement per message
+        (reference: CompiledDAG's actor execution loop,
+        dag/compiled_dag_node.py:480)."""
+        from ray_tpu.dag.compiled import DagError
+        from ray_tpu.experimental.channel import ChannelClosed, ShmChannel
+
+        opened: list = []
+        outs: list = []
+        try:
+            args, _ = self._resolve_args(spec)
+            cfg = args[0]
+            method = getattr(self.actor_instance, cfg["method"])
+            srcs: list = []
+            for kind, v in cfg["args"]:
+                if kind == "ch":
+                    ch = ShmChannel(v)
+                    opened.append(ch)
+                    srcs.append(ch)
+                else:
+                    srcs.append((v,))  # constant, pre-wrapped
+            outs = [ShmChannel(n) for n in cfg["out"]]
+            opened.extend(outs)
+            kwargs = cfg.get("kwargs") or {}
+            while True:
+                vals = []
+                closed = False
+                err = None
+                for src in srcs:
+                    if isinstance(src, tuple):
+                        vals.append(src[0])
+                        continue
+                    try:
+                        item = src.read()
+                    except ChannelClosed:
+                        closed = True
+                        break
+                    if isinstance(item, DagError) and err is None:
+                        err = item  # pass the upstream failure through
+                    vals.append(item)
+                if closed:
+                    break
+                if err is not None:
+                    res = err
+                else:
+                    try:
+                        res = method(*vals, **kwargs)
+                    except BaseException as e:
+                        res = DagError(e)
+                # one dumps per message, however many out edges
+                payload = pickle.dumps(res, protocol=5)
+                for o in outs:
+                    o.write_bytes(payload)
+            return self._pack_returns(spec, None)
+        except BaseException as e:
+            return {"status": "error", "error": pickle.dumps(
+                RayTaskError.from_exception(spec.name, e))}
+        finally:
+            # ALWAYS propagate EOF downstream — an error path that skipped
+            # close_write would leave downstream loops and the driver
+            # blocked forever
+            for o in outs:
+                try:
+                    o.close_write()
+                except Exception:
+                    pass
+            for ch in opened:
+                try:
+                    ch.close()
+                except Exception:
+                    pass
 
     def _invoke_normal_sync(self, spec: TaskSpec) -> dict:
         from ray_tpu import runtime_env as renv

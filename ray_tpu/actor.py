@@ -51,6 +51,13 @@ class ActorMethod:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Lazy actor-method DAG node (reference: class_node bind API);
+        compile chains with node.experimental_compile()."""
+        from ray_tpu.dag import ClassMethodNode
+
+        return ClassMethodNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(f"actor method {self._name!r} must be called with .remote()")
 

@@ -6,6 +6,12 @@ task with its upstream refs as arguments, so the runtime's normal dependency
 resolution drives execution order — no extra scheduler.  This is also the
 substrate the workflow layer persists (reference: workflows run DAGs with
 durable step results).
+
+Actor-method graphs additionally support ``experimental_compile()``
+(reference: dag/compiled_dag_node.py:480): the graph's edges become
+persistent shared-memory channels and each actor runs a channel-driven loop,
+so repeated executes bypass the per-call lease/RPC path entirely — see
+``ray_tpu.dag.compiled``.
 """
 
 from __future__ import annotations
@@ -54,3 +60,57 @@ class DAGNode:
 
     def __repr__(self):
         return f"DAGNode({self.fn_name()})"
+
+
+class InputNode(DAGNode):
+    """Placeholder for the value supplied at ``compiled.execute(value)``
+    (reference: dag/input_node.py).  Usable as a context manager for API
+    parity: ``with InputNode() as inp: ...``."""
+
+    def __init__(self):
+        super().__init__(None, (), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _submit(self, memo):
+        raise TypeError("a DAG containing InputNode must be compiled with "
+                        "experimental_compile() and run via execute(value)")
+
+    def __repr__(self):
+        return "InputNode()"
+
+
+class ClassMethodNode(DAGNode):
+    """A bound actor-method invocation (reference: dag/class_node.py)."""
+
+    def __init__(self, actor_method, args: Tuple, kwargs: Dict[str, Any]):
+        super().__init__(None, args, kwargs)
+        self._actor_method = actor_method
+
+    def _submit(self, memo: Dict[int, Any]):
+        key = id(self)
+        if key in memo:
+            return memo[key]
+        args = [a._submit(memo) if isinstance(a, DAGNode) else a
+                for a in self._bound_args]
+        kwargs = {k: (v._submit(memo) if isinstance(v, DAGNode) else v)
+                  for k, v in self._bound_kwargs.items()}
+        ref = self._actor_method.remote(*args, **kwargs)
+        memo[key] = ref
+        return ref
+
+    def experimental_compile(self, max_buf: int = 1 << 20, depth: int = 2):
+        """Compile this graph into persistent channels + actor loops."""
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self, max_buf=max_buf, depth=depth)
+
+    def fn_name(self) -> str:
+        return self._actor_method._name
+
+    def __repr__(self):
+        return f"ClassMethodNode({self.fn_name()})"

@@ -1,0 +1,3 @@
+"""Experimental surfaces (reference: python/ray/experimental/)."""
+
+from ray_tpu.experimental.channel import ShmChannel  # noqa: F401

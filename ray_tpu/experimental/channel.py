@@ -1,0 +1,189 @@
+"""Pre-arranged shared-memory channels for compiled DAGs.
+
+Counterpart of the reference's mutable-plasma channels (reference:
+python/ray/experimental/channel/shared_memory_channel.py,
+src/ray/core_worker/experimental_mutable_object_manager.h): a compiled DAG's
+edges are fixed at compile time, so each edge gets a persistent
+single-producer/single-consumer ring in POSIX shared memory.  Data moves by
+one memcpy with NO per-message runtime involvement — no lease, no RPC frame,
+no event-loop hop.  The reference's NCCL device channels
+(torch_tensor_nccl_channel.py:191) have no single-host TPU analogue; on-chip
+tensors cross process boundaries via host shm here, and multi-chip device
+transfer rides the collective layer instead.
+
+Layout (little-endian u64s):
+    [0]  head      — messages written (producer-owned)
+    [8]  tail      — messages consumed (consumer-owned)
+    [16] slot_size
+    [24] depth
+    slots: depth x (u64 length + slot_size payload bytes)
+
+Aligned 8-byte stores are atomic and each counter has exactly one writer, so
+the ring needs no lock on x86-64, whose TSO memory model also guarantees the
+payload stores are visible before the head publish.  Weakly-ordered ISAs
+(ARM64) would need a release/acquire barrier Python cannot express — TPU
+hosts are x86-64, so that port is out of scope.  Waiting is hybrid: a short
+GIL-yield spin for the latency-critical case, then exponential sleep backoff.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from multiprocessing import resource_tracker, shared_memory
+from typing import Any, Optional
+
+_HDR = 32
+_SLOT_HDR = 8
+
+# Sentinel lengths (no payload).
+_LEN_CLOSE = (1 << 64) - 1
+
+
+class ChannelClosed(Exception):
+    pass
+
+
+class ChannelFull(Exception):
+    pass
+
+
+def _attach(name: str) -> shared_memory.SharedMemory:
+    shm = shared_memory.SharedMemory(name=name)
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")  # type: ignore[attr-defined]
+    except Exception:
+        pass
+    return shm
+
+
+class ShmChannel:
+    """One SPSC ring.  ``create=True`` allocates (owner unlinks); readers and
+    writers attach by name."""
+
+    def __init__(self, name: Optional[str] = None, *, create: bool = False,
+                 slot_size: int = 1 << 20, depth: int = 2):
+        if create:
+            size = _HDR + depth * (_SLOT_HDR + slot_size)
+            self._shm = shared_memory.SharedMemory(create=True, size=size)
+            # stay registered with the resource tracker: our close() unlinks,
+            # which also unregisters (3.12); a crashed driver then still gets
+            # tracker cleanup instead of leaking /dev/shm segments
+            self._owner = True
+            buf = self._shm.buf
+            buf[:_HDR] = b"\x00" * _HDR
+            buf[16:24] = slot_size.to_bytes(8, "little")
+            buf[24:32] = depth.to_bytes(8, "little")
+        else:
+            assert name is not None
+            self._shm = _attach(name)
+            self._owner = False
+        buf = self._shm.buf
+        self.slot_size = int.from_bytes(buf[16:24], "little")
+        self.depth = int.from_bytes(buf[24:32], "little")
+        self.name = self._shm.name
+
+    # ------------------------------------------------------------ counters
+    def _head(self) -> int:
+        return int.from_bytes(self._shm.buf[0:8], "little")
+
+    def _tail(self) -> int:
+        return int.from_bytes(self._shm.buf[8:16], "little")
+
+    def _set_head(self, v: int) -> None:
+        self._shm.buf[0:8] = v.to_bytes(8, "little")
+
+    def _set_tail(self, v: int) -> None:
+        self._shm.buf[8:16] = v.to_bytes(8, "little")
+
+    def _slot(self, i: int):
+        off = _HDR + (i % self.depth) * (_SLOT_HDR + self.slot_size)
+        return off
+
+    @staticmethod
+    def _wait(cond, timeout: Optional[float]):
+        """Hybrid wait: yield-spin briefly, then sleep with backoff."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        spin = 0
+        delay = 20e-6
+        while not cond():
+            if spin < 100:
+                spin += 1
+                time.sleep(0)  # drop the GIL / yield the core
+                continue
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel wait timed out")
+            time.sleep(delay)
+            delay = min(delay * 2, 2e-3)
+
+    # -------------------------------------------------------------- write
+    def wait_writable(self, timeout: Optional[float] = None) -> None:
+        """Block until the ring has room.  With a single producer the room
+        cannot disappear before the producer's own next write."""
+        head = self._head()
+        self._wait(lambda: head - self._tail() < self.depth, timeout)
+
+    def write_bytes(self, payload: bytes, timeout: Optional[float] = None) -> None:
+        n = len(payload)
+        if n > self.slot_size:
+            raise ChannelFull(
+                f"message of {n} bytes exceeds channel slot size "
+                f"{self.slot_size}; recompile with a larger max_buf")
+        head = self._head()
+        self._wait(lambda: head - self._tail() < self.depth, timeout)
+        off = self._slot(head)
+        buf = self._shm.buf
+        buf[off + _SLOT_HDR:off + _SLOT_HDR + n] = payload
+        buf[off:off + _SLOT_HDR] = n.to_bytes(8, "little")
+        self._set_head(head + 1)
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        self.write_bytes(pickle.dumps(value, protocol=5), timeout)
+
+    def close_write(self, timeout: float = 60.0) -> None:
+        """Producer EOF: wakes the consumer with a close sentinel.  Waits
+        out a full ring (a slow consumer must still drain the buffered
+        messages first); only a consumer gone for `timeout` loses the
+        sentinel."""
+        try:
+            head = self._head()
+            self._wait(lambda: head - self._tail() < self.depth,
+                       timeout=timeout)
+            off = self._slot(head)
+            self._shm.buf[off:off + _SLOT_HDR] = _LEN_CLOSE.to_bytes(8, "little")
+            self._set_head(head + 1)
+        except (TimeoutError, ValueError):
+            pass
+
+    # --------------------------------------------------------------- read
+    def read_bytes(self, timeout: Optional[float] = None) -> bytes:
+        tail = self._tail()
+        self._wait(lambda: self._head() > tail, timeout)
+        off = self._slot(tail)
+        buf = self._shm.buf
+        n = int.from_bytes(buf[off:off + _SLOT_HDR], "little")
+        if n == _LEN_CLOSE:
+            self._set_tail(tail + 1)
+            raise ChannelClosed("producer closed the channel")
+        payload = bytes(buf[off + _SLOT_HDR:off + _SLOT_HDR + n])
+        self._set_tail(tail + 1)
+        return payload
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        return pickle.loads(self.read_bytes(timeout))
+
+    # ----------------------------------------------------------- lifecycle
+    def close(self) -> None:
+        try:
+            self._shm.close()
+        except BufferError:
+            pass
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except FileNotFoundError:
+                pass
+
+    def __reduce__(self):
+        # channels travel by name; the receiving process attaches
+        return (type(self), (self.name,))

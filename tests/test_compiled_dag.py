@@ -1,0 +1,126 @@
+"""Compiled DAGs: persistent shm channels + actor loops (reference test
+shape: python/ray/dag/tests/experimental/test_accelerated_dag.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode
+from ray_tpu.experimental.channel import ChannelClosed, ShmChannel
+
+
+@pytest.fixture
+def cluster():
+    from conftest import ensure_shared_runtime
+
+    yield ensure_shared_runtime()
+
+
+def test_shm_channel_roundtrip():
+    ch = ShmChannel(create=True, slot_size=1 << 16, depth=2)
+    try:
+        reader = ShmChannel(ch.name)
+        ch.write({"a": np.arange(4)})
+        out = reader.read(timeout=5)
+        np.testing.assert_array_equal(out["a"], np.arange(4))
+        # ring depth gives backpressure, then drains
+        ch.write(1)
+        ch.write(2)
+        assert reader.read(timeout=5) == 1
+        ch.write(3)
+        assert reader.read(timeout=5) == 2
+        assert reader.read(timeout=5) == 3
+        ch.close_write()
+        with pytest.raises(ChannelClosed):
+            reader.read(timeout=5)
+        reader.close()
+    finally:
+        ch.close()
+
+
+@ray_tpu.remote
+class _Stage:
+    def __init__(self, k):
+        self.k = k
+
+    def add(self, x):
+        return x + self.k
+
+    def boom(self, x):
+        raise ValueError("stage exploded")
+
+
+def test_compiled_chain_and_reuse(cluster):
+    a = _Stage.options(num_cpus=0.1).remote(1)
+    b = _Stage.options(num_cpus=0.1).remote(10)
+    c = _Stage.options(num_cpus=0.1).remote(100)
+    with InputNode() as inp:
+        dag = c.add.bind(b.add.bind(a.add.bind(inp)))
+    compiled = dag.experimental_compile()
+    try:
+        for i in range(20):
+            assert compiled.execute(i).get(timeout=30) == i + 111
+        # pipelined executes (ring depth 2)
+        refs = [compiled.execute(i) for i in range(2)]
+        assert [r.get(timeout=30) for r in refs] == [111, 112]
+    finally:
+        compiled.teardown()
+    # after teardown the actors serve normal calls again
+    assert ray_tpu.get(a.add.remote(5), timeout=60) == 6
+    for h in (a, b, c):
+        ray_tpu.kill(h)
+
+
+def test_compiled_error_propagates(cluster):
+    a = _Stage.options(num_cpus=0.1).remote(1)
+    b = _Stage.options(num_cpus=0.1).remote(2)
+    with InputNode() as inp:
+        dag = b.add.bind(a.boom.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        with pytest.raises(ValueError, match="stage exploded"):
+            compiled.execute(1).get(timeout=30)
+        # the pipeline stays alive after an error
+        with pytest.raises(ValueError):
+            compiled.execute(2).get(timeout=30)
+    finally:
+        compiled.teardown()
+    ray_tpu.kill(a)
+    ray_tpu.kill(b)
+
+
+def test_compiled_beats_remote_chain_latency(cluster):
+    """VERDICT r3 'done' bar: >=5x lower per-hop latency than .remote()
+    chains through a 3-actor pipeline."""
+    stages = [_Stage.options(num_cpus=0.1).remote(i) for i in range(3)]
+    # warm the workers
+    ray_tpu.get([s.add.remote(0) for s in stages], timeout=120)
+
+    n = 30
+    t0 = time.perf_counter()
+    for i in range(n):
+        r = stages[0].add.remote(i)
+        r = stages[1].add.remote(r)
+        r = stages[2].add.remote(r)
+        ray_tpu.get(r, timeout=60)
+    remote_dt = (time.perf_counter() - t0) / n
+
+    with InputNode() as inp:
+        dag = stages[2].add.bind(stages[1].add.bind(stages[0].add.bind(inp)))
+    compiled = dag.experimental_compile()
+    try:
+        compiled.execute(0).get(timeout=30)  # attach/warm the loops
+        t0 = time.perf_counter()
+        for i in range(n):
+            assert compiled.execute(i).get(timeout=30) == i + 3
+        compiled_dt = (time.perf_counter() - t0) / n
+    finally:
+        compiled.teardown()
+    speedup = remote_dt / compiled_dt
+    print(f"remote chain {remote_dt*1e3:.2f} ms vs compiled "
+          f"{compiled_dt*1e3:.2f} ms -> {speedup:.1f}x")
+    assert speedup >= 5.0, (remote_dt, compiled_dt)
+    for h in stages:
+        ray_tpu.kill(h)
