@@ -1,0 +1,48 @@
+"""V-trace off-policy correction as a parallel scan.
+
+Counterpart of the reference's V-trace (reference:
+rllib/algorithms/impala/vtrace_torch.py; the IMPALA paper's eq. 1): actors
+sample with stale behavior policies, the learner corrects with clipped
+importance ratios.  TPU-native: like GAE (ops/gae.py), the correction
+``vs_t - V_t = delta_t + gamma c_t (1-done_t)(vs_{t+1} - V_{t+1})`` is a
+first-order linear recurrence, so it runs as an O(log T)-depth
+``associative_scan`` instead of a serial backward loop.
+
+Fragment conventions match the EnvRunner: time-major (T, K) arrays;
+``next_values`` is the value of the TRUE successor state (0 at termination,
+V(final_obs) at truncation), so episode-boundary bootstrapping is already
+baked in and the recurrence only needs the (1 - done) cut.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from ray_tpu.ops.gae import _reverse_linrec
+
+
+def vtrace_from_fragments(behavior_logp, target_logp, rewards, values,
+                          next_values, dones, gamma: float,
+                          rho_clip: float = 1.0, c_clip: float = 1.0
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (vs, pg_advantages), both (T, K), gradient-stopped inputs
+    expected (call with stop_gradient'ed values)."""
+    not_done = 1.0 - dones.astype(rewards.dtype)
+    rhos = jnp.exp(target_logp - behavior_logp)
+    rho = jnp.minimum(rhos, rho_clip)
+    c = jnp.minimum(rhos, c_clip)
+
+    delta = rho * (rewards + gamma * next_values - values)
+    # A_t = delta_t + gamma c_t (1-done_t) A_{t+1}
+    coeff = gamma * c * not_done
+    a = _reverse_linrec(coeff, delta)
+    vs = values + a
+
+    # policy-gradient advantages: r_t + gamma vs_{t+1} - V_t, bootstrapping
+    # with next_values at fragment tails and episode boundaries
+    vs_next = jnp.concatenate([vs[1:], next_values[-1:]], axis=0)
+    vs_next = jnp.where(dones, next_values, vs_next)
+    pg_adv = rho * (rewards + gamma * vs_next - values)
+    return vs, pg_adv

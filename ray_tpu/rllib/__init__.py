@@ -6,6 +6,8 @@ Algorithm is the Tune-trainable driver loop).
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 
-__all__ = ["Algorithm", "AlgorithmConfig", "PPO", "PPOConfig"]
+__all__ = ["Algorithm", "AlgorithmConfig", "IMPALA", "IMPALAConfig",
+           "PPO", "PPOConfig"]

@@ -78,6 +78,36 @@ class AlgorithmConfig:
         raise NotImplementedError
 
 
+def build_module_spec(config: "AlgorithmConfig") -> Dict[str, Any]:
+    """Probe the env once and derive the policy-module spec (shared by every
+    algorithm; reference: catalog/module-spec derivation)."""
+    from ray_tpu.rllib.env import make_vector_env
+
+    probe = make_vector_env(config.env, 1, seed=0)
+    return {
+        "observation_size": probe.observation_size,
+        "num_actions": probe.num_actions,
+        "hidden": tuple(config.model.get("hidden", (64, 64))),
+    }
+
+
+def build_runner_actors(config: "AlgorithmConfig", module_spec: Dict) -> list:
+    """Spawn the EnvRunner actor gang (reference: EnvRunnerGroup)."""
+    import ray_tpu
+    from ray_tpu.rllib.env.env_runner import EnvRunner
+
+    runner_cls = ray_tpu.remote(EnvRunner)
+    return [
+        runner_cls.options(num_cpus=1).remote(
+            env_name=config.env,
+            num_envs=config.num_envs_per_env_runner,
+            rollout_length=config.rollout_fragment_length,
+            module_spec=module_spec,
+            seed=config.seed + 1000 * (i + 1))
+        for i in range(config.num_env_runners)
+    ]
+
+
 class Algorithm:
     """reference: rllib/algorithms/algorithm.py:227 (step :896)."""
 

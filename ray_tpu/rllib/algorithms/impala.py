@@ -1,0 +1,223 @@
+"""IMPALA: asynchronous sampling + V-trace off-policy correction.
+
+Counterpart of the reference's IMPALA (reference:
+rllib/algorithms/impala/impala.py:132-133 — actors sample continuously into
+queues, the learner consumes without a synchronization barrier;
+vtrace_torch.py for the correction math).  Control flow here:
+
+- every runner actor always has ONE sample() in flight; training_step waits
+  for whichever fragments are ready (``ray_tpu.wait``), updates with those,
+  and immediately relaunches the runners with the new weights — runners
+  never wait for the learner, the learner never waits for stragglers;
+- sampled fragments are therefore 1+ policy versions stale: the jitted
+  learner recomputes target logp/values and corrects with clipped
+  importance ratios (ops/vtrace.py) in a single pass (no PPO-style epochs).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_env_runners = 2  # async needs actor runners
+        self.training_params = {
+            "lr": 5e-4,
+            "gamma": 0.99,
+            "rho_clip": 1.0,
+            "c_clip": 1.0,
+            "vf_loss_coeff": 0.5,
+            "entropy_coeff": 0.01,
+            "grad_clip": 40.0,
+        }
+
+    @property
+    def algo_class(self):
+        return IMPALA
+
+
+class IMPALA(Algorithm):
+    def setup(self, config: IMPALAConfig) -> None:
+        import ray_tpu
+
+        from ray_tpu.rllib.algorithms.algorithm import (build_module_spec,
+                                                        build_runner_actors)
+
+        self._module_spec = build_module_spec(config)
+        self.learner = _ImpalaLearner(
+            self._module_spec, config.training_params, seed=config.seed,
+            platform=config.learner_platform)
+
+        if config.num_env_runners <= 0:
+            raise ValueError("IMPALA needs actor env-runners "
+                             "(num_env_runners >= 1): the sampling is async")
+        self._runners = build_runner_actors(config, self._module_spec)
+        # one in-flight sample per runner, launched with the initial weights
+        wref = ray_tpu.put(self.learner.get_weights())
+        self._inflight: Dict[Any, Any] = {
+            r.sample.remote(wref): r for r in self._runners}
+        self._steps_sampled = 0
+        self._sample_t0 = time.monotonic()
+
+    # ------------------------------------------------------------ one iter
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        # consume whatever is ready — NO barrier across runners
+        ready, _ = ray_tpu.wait(
+            list(self._inflight), num_returns=1, timeout=300)
+        if not ready:
+            raise TimeoutError("no env-runner produced a fragment in 300s")
+        # opportunistically grab anything else already done
+        more, _ = ray_tpu.wait(
+            [r for r in self._inflight if r not in ready],
+            num_returns=len(self._inflight) - len(ready), timeout=0)
+        ready += more
+        batches = ray_tpu.get(ready)
+        done_runners = [self._inflight.pop(ref) for ref in ready]
+        # metrics BEFORE relaunching: the runner actor is serial, so a
+        # get_metrics queued behind a fresh sample() would block this step
+        # on a whole new fragment — exactly the barrier IMPALA removes
+        metric_refs = [r.get_metrics.remote() for r in done_runners]
+
+        # one update per fragment: every fragment has the same (T, K) shape,
+        # so the jitted update compiles ONCE (a variable-width concat would
+        # recompile per distinct ready-count)
+        for b in batches:
+            stats = self.learner.update(b)
+            self._steps_sampled += int(b["rewards"].size)
+
+        # relaunch the drained runners with the new weights; the others keep
+        # sampling their (now stale) policies — that staleness is exactly
+        # what V-trace corrects
+        wref = ray_tpu.put(self.learner.get_weights())
+        for r in done_runners:
+            self._inflight[r.sample.remote(wref)] = r
+
+        metrics = ray_tpu.get(metric_refs)
+        returns = [m["episode_return_mean"] for m in metrics
+                   if np.isfinite(m["episode_return_mean"])]
+        dt = time.monotonic() - self._sample_t0
+        return {
+            "episode_return_mean": float(np.mean(returns)) if returns
+            else float("nan"),
+            "num_env_steps_sampled_lifetime": self._steps_sampled,
+            "env_steps_per_s": self._steps_sampled / max(dt, 1e-9),
+            "num_fragments_consumed": len(batches),
+            **{f"learner/{k}": v for k, v in stats.items()},
+        }
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for r in self._runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self._runners = []
+        self._inflight = {}
+
+
+class _ImpalaLearner:
+    """Single-pass V-trace learner; whole update under one jit (the IMPALA
+    counterpart of the PPO JaxLearner in core/learner.py)."""
+
+    def __init__(self, module_spec: Dict, config: Dict, seed: int = 0,
+                 platform=None):
+        if platform == "cpu":
+            from ray_tpu._private.platform import force_cpu_platform
+
+            force_cpu_platform(1)
+        import jax
+        import optax
+
+        from ray_tpu.rllib.core.rl_module import DiscretePolicyModule
+
+        self.module = DiscretePolicyModule(**module_spec)
+        self.config = dict(config)
+        self.params = self.module.init(jax.random.PRNGKey(seed))
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(self.config.get("grad_clip", 40.0)),
+            optax.adam(self.config.get("lr", 5e-4)),
+        )
+        self.opt_state = self.tx.init(self.params)
+        self._update = jax.jit(functools.partial(
+            _impala_update, self.module, self.tx,
+            gamma=self.config.get("gamma", 0.99),
+            rho_clip=self.config.get("rho_clip", 1.0),
+            c_clip=self.config.get("c_clip", 1.0),
+            vf_loss_coeff=self.config.get("vf_loss_coeff", 0.5),
+            entropy_coeff=self.config.get("entropy_coeff", 0.01),
+        ))
+
+    def update(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        self.params, self.opt_state, stats = self._update(
+            self.params, self.opt_state, batch)
+        return {k: float(v) for k, v in stats.items()}
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+
+def _impala_update(module, tx, params, opt_state, batch, *, gamma, rho_clip,
+                   c_clip, vf_loss_coeff, entropy_coeff):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.ops.vtrace import vtrace_from_fragments
+
+    T, K = batch["rewards"].shape
+    obs = batch["obs"].reshape(T * K, -1)
+    actions = batch["actions"].reshape(T * K)
+    dones = batch["terminated"] | batch["truncated"]
+
+    def loss_fn(p):
+        # target policy/value under CURRENT params; behavior logp/values in
+        # the batch came from the stale runner weights
+        logp, entropy = module.logp_entropy(p, obs, actions)
+        v = module.value(p, obs)
+        logp_t = logp.reshape(T, K)
+        v_t = v.reshape(T, K)
+        # successor values under the current value net: v[t+1] inside the
+        # fragment, runner-provided bootstrap at the tail, 0/bootstrap at
+        # episode boundaries (next_values bakes those in; scale by the
+        # ratio of current to behavior tail values is not needed — vtrace
+        # uses the current estimates everywhere except boundaries where the
+        # runner's bootstrap stands in)
+        nv = jnp.concatenate([v_t[1:], batch["next_values"][-1:]], axis=0)
+        nv = jnp.where(dones, batch["next_values"], nv)
+        vs, pg_adv = vtrace_from_fragments(
+            batch["logp"], jax.lax.stop_gradient(logp_t),
+            batch["rewards"], jax.lax.stop_gradient(v_t),
+            jax.lax.stop_gradient(nv), dones, gamma, rho_clip, c_clip)
+        pg_loss = -(jax.lax.stop_gradient(pg_adv) * logp_t).mean()
+        vf_loss = 0.5 * ((v_t - jax.lax.stop_gradient(vs)) ** 2).mean()
+        loss = (pg_loss + vf_loss_coeff * vf_loss
+                - entropy_coeff * entropy.mean())
+        return loss, {
+            "policy_loss": pg_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy.mean(),
+            "mean_vtrace_target": vs.mean(),
+            "mean_is_ratio": jnp.exp(
+                jax.lax.stop_gradient(logp_t) - batch["logp"]).mean(),
+        }
+
+    (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    stats["total_loss"] = loss
+    return params, opt_state, stats

@@ -16,7 +16,6 @@ import numpy as np
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.core.learner import LearnerGroup
-from ray_tpu.rllib.env import make_vector_env
 from ray_tpu.rllib.env.env_runner import EnvRunner
 
 
@@ -43,36 +42,27 @@ class PPOConfig(AlgorithmConfig):
 
 class PPO(Algorithm):
     def setup(self, config: PPOConfig) -> None:
-        probe = make_vector_env(config.env, 1, seed=0)
-        self._module_spec = {
-            "observation_size": probe.observation_size,
-            "num_actions": probe.num_actions,
-            "hidden": tuple(config.model.get("hidden", (64, 64))),
-        }
+        from ray_tpu.rllib.algorithms.algorithm import (build_module_spec,
+                                                        build_runner_actors)
+
+        self._module_spec = build_module_spec(config)
         self.learner_group = LearnerGroup(
             self._module_spec, config.training_params,
             num_learners=config.num_learners, seed=config.seed,
             platform=config.learner_platform)
 
-        runner_args = dict(
-            env_name=config.env,
-            num_envs=config.num_envs_per_env_runner,
-            rollout_length=config.rollout_fragment_length,
-            module_spec=self._module_spec,
-        )
         self._local_runner = None
         self._runner_actors = []
         if config.num_env_runners <= 0:
-            self._local_runner = EnvRunner(**runner_args, seed=config.seed)
+            self._local_runner = EnvRunner(
+                env_name=config.env,
+                num_envs=config.num_envs_per_env_runner,
+                rollout_length=config.rollout_fragment_length,
+                module_spec=self._module_spec,
+                seed=config.seed)
         else:
-            import ray_tpu
-
-            runner_cls = ray_tpu.remote(EnvRunner)
-            self._runner_actors = [
-                runner_cls.options(num_cpus=1).remote(
-                    **runner_args, seed=config.seed + 1000 * (i + 1))
-                for i in range(config.num_env_runners)
-            ]
+            self._runner_actors = build_runner_actors(
+                config, self._module_spec)
 
     # ------------------------------------------------------------ one iter
     def training_step(self) -> Dict[str, Any]:
