@@ -425,16 +425,36 @@ class GcsServer:
                     import pickle as _p
 
                     spec = _p.loads(a.spec)
+                    s = spec.scheduling_strategy
+                    if getattr(s, "kind", None) == "placement_group":
+                        continue  # its bundle below is the demand already
                     if spec.resources:
                         demand.append(dict(spec.resources))
                 except Exception:
                     pass
+        # unplaced placement-group bundles: gang demand the autoscaler must
+        # provision for (reference: placement-group demand in the autoscaler
+        # state service, autoscaler.proto GangResourceRequest).  STRICT
+        # strategies carry a _gang marker so the bin-packer preserves
+        # anti-affinity (one bundle per node) instead of absorbing the whole
+        # gang into one node's free capacity.
+        for pg in self.pg_manager.groups.values():
+            if pg.state in ("PENDING", "RESCHEDULING"):
+                for bundle, node in zip(pg.bundles, pg.bundle_nodes):
+                    if node is None:
+                        d = dict(bundle)
+                        if pg.strategy in ("STRICT_SPREAD", "SPREAD"):
+                            d["_gang"] = pg.pg_id.hex()
+                        demand.append(d)
         return {
             "nodes": [
                 {"node_id": n.node_id.binary(), "node_name": n.node_name,
                  "alive": n.alive, "total": n.resources_total,
                  "available": n.resources_available,
                  "labels": n.labels, "start_time": n.start_time,
+                 # age computed on THIS clock so autoscalers on other
+                 # machines aren't exposed to cross-host clock skew
+                 "age_s": max(time.time() - n.start_time, 0.0),
                  # A node hosting any leased worker or live actor is never
                  # idle, even when resource accounting looks free: queue
                  # actors / Serve replicas default to num_cpus=0 and would

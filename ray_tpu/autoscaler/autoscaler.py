@@ -36,6 +36,10 @@ class NodeTypeConfig:
     resources: Dict[str, float]
     min_workers: int = 0
     max_workers: int = 10
+    # Provider-specific launch parameters (reference: the node_config block
+    # of cluster YAMLs) — e.g. {"tpu_pod_type": "v5e-16"} makes the TPU
+    # provider provision whole slices.
+    node_config: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass
@@ -117,36 +121,54 @@ class StandardAutoscaler:
         if not demand:
             return
         # capacity still free on live nodes absorbs demand first, then
-        # capacity already on its way up (pending launches within the grace)
+        # capacity already on its way up (pending launches within the grace).
+        # Each free-capacity slot tracks which gangs it already absorbed a
+        # bundle of: STRICT_SPREAD bundles are node-anti-affine, so a single
+        # node must never swallow two of them (it could not actually host
+        # them, deadlocking the gang with zero launches).
         now = time.monotonic()
         self._pending_launches = [
             (ts, res) for ts, res in self._pending_launches
             if now - ts < self.launch_grace_s]
-        frees = [dict(n["available"]) for n in status["nodes"] if n["alive"]]
-        frees.extend(dict(res) for _ts, res in self._pending_launches)
+        frees = [[dict(n["available"]), set()]
+                 for n in status["nodes"] if n["alive"]]
+        frees.extend([dict(res), set()]
+                     for _ts, res in self._pending_launches)
         unmet: List[Dict[str, float]] = []
-        for req in demand:
+        for d in demand:
+            req = dict(d)
+            gang = req.pop("_gang", None)
             placed = False
-            for avail in frees:
+            for avail, gangs in frees:
+                if gang is not None and gang in gangs:
+                    continue
                 if _fits(avail, req):
                     _consume(avail, req)
+                    if gang is not None:
+                        gangs.add(gang)
                     placed = True
                     break
             if not placed:
-                unmet.append(req)
+                unmet.append(d)
         if not unmet:
             return
         # bin-pack unmet demand onto new nodes of the configured types
         to_launch: Dict[str, int] = {}
-        virtual: List[Dict[str, float]] = []
+        virtual: List[list] = []  # [avail, gangs]
         counts = {t: len(self.provider.non_terminated_nodes(
             {TAG_NODE_TYPE: t})) for t in self.config.node_types}
         total_now = sum(counts.values())
-        for req in unmet:
+        for d in unmet:
+            req = dict(d)
+            gang = req.pop("_gang", None)
             placed = False
-            for avail in virtual:
+            for avail, gangs in virtual:
+                if gang is not None and gang in gangs:
+                    continue
                 if _fits(avail, req):
                     _consume(avail, req)
+                    if gang is not None:
+                        gangs.add(gang)
                     placed = True
                     break
             if placed:
@@ -162,7 +184,7 @@ class StandardAutoscaler:
                 to_launch[tname] = to_launch.get(tname, 0) + 1
                 fresh = dict(tcfg.resources)
                 _consume(fresh, req)
-                virtual.append(fresh)
+                virtual.append([fresh, {gang} if gang is not None else set()])
                 placed = True
                 break
             if not placed:
@@ -174,21 +196,33 @@ class StandardAutoscaler:
         tcfg = self.config.node_types[tname]
         logger.info("autoscaler launching %d x %s (%s)", count, tname,
                     tcfg.resources)
+        created = 0
         try:
-            self.provider.create_node(
-                {"resources": tcfg.resources},
+            # providers may return how many nodes they actually created
+            # (slice providers can partially succeed); None means all
+            created = self.provider.create_node(
+                {"resources": tcfg.resources, **tcfg.node_config},
                 {TAG_NODE_TYPE: tname, TAG_NODE_STATUS: STATUS_UP}, count)
-            self.launched[tname] = self.launched.get(tname, 0) + count
-            now = time.monotonic()
-            self._pending_launches.extend(
-                (now, dict(tcfg.resources)) for _ in range(count))
+            if created is None:
+                created = count
         except Exception:
             logger.exception("launch of %s failed", tname)
+        if created:
+            self.launched[tname] = self.launched.get(tname, 0) + created
+            now = time.monotonic()
+            self._pending_launches.extend(
+                (now, dict(tcfg.resources)) for _ in range(created))
 
     def _scale_down(self, status: dict) -> None:
         now = time.monotonic()
+        # Launch grace: a freshly-provisioned node is idle until the demand
+        # that caused its launch schedules onto it (gangs wait for EVERY
+        # host of a slice) — reaping it in that window livelocks scale-up.
+        # age_s is computed on the GCS clock, immune to cross-host skew.
+        grace = min(self.launch_grace_s, self.config.idle_timeout_s + 30.0)
         idle_names = {n["node_name"] for n in status["nodes"]
-                      if n["alive"] and n["idle"]}
+                      if n["alive"] and n["idle"]
+                      and n.get("age_s", float("inf")) >= grace}
         for nid in list(self._idle_since):
             if nid not in idle_names:
                 del self._idle_since[nid]

@@ -34,7 +34,9 @@ class NodeProvider:
         raise NotImplementedError
 
     def create_node(self, node_config: Dict[str, Any], tags: Dict[str, str],
-                    count: int) -> None:
+                    count: int) -> Optional[int]:
+        """Returns how many nodes were actually created, or None meaning
+        `count` (slice providers can partially succeed)."""
         raise NotImplementedError
 
     def terminate_node(self, node_id: str) -> None:

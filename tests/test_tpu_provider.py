@@ -1,0 +1,99 @@
+"""TPU-VM node provider + fake cloud: slice-aware autoscaling (reference:
+gcp/config.py TPU validation, tpu_command_runner.py, FakeMultiNodeProvider).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import (AutoscalingConfig, NodeTypeConfig,
+                                StandardAutoscaler)
+from ray_tpu.autoscaler.tpu_provider import (FakeTpuCloud, TPUNodeProvider,
+                                             slice_hosts,
+                                             slice_host_resources)
+from ray_tpu.util.placement_group import (placement_group,
+                                          remove_placement_group)
+
+
+def test_slice_math():
+    assert slice_hosts("v5e-16") == 4
+    assert slice_hosts("v5e-4") == 1
+    assert slice_hosts("v4-32") == 8
+    res0 = slice_host_resources("v5e-16", "slice-a", 0)
+    assert res0["TPU"] == 4.0 and res0["slice-a"] == 1.0
+    assert res0["TPU-v5e-16-head"] == 1.0
+    res1 = slice_host_resources("v5e-16", "slice-a", 1)
+    assert "TPU-v5e-16-head" not in res1
+    with pytest.raises(ValueError):
+        slice_hosts("v5e-banana")
+
+
+@pytest.fixture
+def tpu_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    cluster.add_node(num_cpus=1)  # CPU-only head
+    ray_tpu.init(address=cluster.address)
+    cluster.wait_for_nodes()
+    api = FakeTpuCloud(gcs_addr=list(cluster.gcs_addr),
+                       session_dir=cluster.head_node.session_dir,
+                       provision_delay_s=0.5, fail_creates=1)
+    provider = TPUNodeProvider({}, "tputest", api=api)
+    try:
+        yield cluster, provider, api
+    finally:
+        ray_tpu.shutdown()
+        provider.shutdown()
+        cluster.shutdown()
+
+
+def _gcs_call(method, msg):
+    core = ray_tpu._private.worker.require_core()
+    return core.io.run(core.gcs_conn.call(method, msg))
+
+
+def test_strict_spread_gang_scales_v5e16_slice(tpu_cluster):
+    """A STRICT_SPREAD gang of 4 TPU-host bundles makes the autoscaler
+    provision one simulated v5e-16 slice (4 hosts) through the fake cloud —
+    surviving one injected create failure and the provisioning delay —
+    and the gang schedules one bundle per host."""
+    cluster, provider, api = tpu_cluster
+    config = AutoscalingConfig(
+        node_types={"tpu-v5e-16": NodeTypeConfig(
+            resources={"CPU": 1.0, "TPU": 4.0},
+            max_workers=8,
+            node_config={"tpu_pod_type": "v5e-16"})},
+        max_workers=8, idle_timeout_s=5.0, update_interval_s=0.5)
+    scaler = StandardAutoscaler(config, provider, _gcs_call)
+    scaler.start()
+    try:
+        pg = placement_group([{"TPU": 4.0}] * 4, strategy="STRICT_SPREAD")
+        assert pg.ready(timeout=180), "gang never became schedulable"
+        # one slice, four hosts
+        hosts = provider.non_terminated_nodes({})
+        assert len(hosts) == 4, hosts
+        slices = {provider.node_tags(h)["tpu-slice"] for h in hosts}
+        assert len(slices) == 1, slices
+        # the injected quota failure was retried through
+        assert api.creates_attempted >= 2
+        # bundles landed on four distinct nodes (STRICT_SPREAD)
+        info = _gcs_call("get_placement_group", {"pg_id": pg.id.binary()})
+        nodes = {tuple(n) if isinstance(n, list) else n
+                 for n in info["bundle_nodes"]}
+        assert len(nodes) == 4
+
+        remove_placement_group(pg)
+        # all four hosts go idle together -> the slice is deleted atomically
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if not provider.non_terminated_nodes({}):
+                break
+            time.sleep(0.5)
+        assert not provider.non_terminated_nodes({}), \
+            "idle slice never reaped"
+        assert api.slice_state(next(iter(slices))) == "DELETED"
+    finally:
+        scaler.stop()
