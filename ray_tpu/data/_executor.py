@@ -39,6 +39,13 @@ class DataContext:
     max_output_queue_blocks = 16
     target_min_block_size = 1 * 1024 * 1024
     actor_pool_util_threshold = 2  # queued-per-actor before scaling up
+    # Explicit memory-budget backpressure (reference:
+    # _internal/execution/backpressure_policy/ + resource_manager.py): once
+    # the bytes buffered in operator output queues exceed this, no new
+    # read/map tasks are admitted until the consumer drains.  The bounded
+    # queues cap BLOCK counts; this caps BYTES, which is what actually
+    # protects the object store when blocks are large.
+    max_buffered_bytes = 512 * 1024 * 1024
 
     @classmethod
     def get_current(cls) -> "DataContext":
@@ -113,6 +120,10 @@ class _OpState:
         # input streams in across scheduler iterations.
         self.rows_emitted = 0
         self.tasks_launched = 0
+        # Running average output-block size: the in-flight term of the byte
+        # budget (seeded at the target block size until real data arrives).
+        self.avg_block_bytes = DataContext.target_min_block_size
+        self._blocks_seen = 0
         # actor pool
         self.pool: List[Any] = []
         self.pool_busy: Dict[Any, int] = {}
@@ -173,6 +184,23 @@ class StreamingExecutor:
                 "tasks": st.tasks_launched,
                 "rows_out": max(st.rows_out, st.rows_emitted)}
 
+    def _buffered_bytes(self) -> int:
+        """Bytes the pipeline currently holds: bundles queued in operator
+        input/output deques PLUS an estimate for in-flight tasks (launched
+        reads/maps land regardless of later admission decisions, so they
+        must count against the budget at admission time)."""
+        total = 0
+        for st in self.states:
+            for item in st.output:
+                total += max(item[1].size_bytes, 0)
+            for item in st.input:
+                # Read ops queue ReadTasks here; bundles are (ref, meta)
+                if isinstance(item, tuple) and len(item) == 2 and \
+                        isinstance(item[1], BlockMetadata):
+                    total += max(item[1].size_bytes, 0)
+            total += len(st.inflight) * st.avg_block_bytes
+        return total
+
     def _seed_source(self, src: _OpState):
         op = src.op
         if isinstance(op, L.Read):
@@ -198,8 +226,13 @@ class StreamingExecutor:
         progressed = False
 
         if isinstance(op, L.Read):
+            # The byte budget throttles SOURCES only: bytes enter the
+            # pipeline here, and downstream operators must stay free to
+            # drain what is already buffered (gating them too would
+            # deadlock once the budget trips).
             while (st.input and downstream_room
-                   and len(st.inflight) < ctx.max_tasks_in_flight_per_op):
+                   and len(st.inflight) < ctx.max_tasks_in_flight_per_op
+                   and self._buffered_bytes() < ctx.max_buffered_bytes):
                 task = st.input.popleft()
                 bref, mref = _run_read_task.remote(task)
                 self._track(st, bref, mref)
@@ -328,7 +361,12 @@ class StreamingExecutor:
             seq, mref, actor = st.inflight.pop(bref)
             if actor is not None:
                 st.pool_busy[actor] -= 1
-            st.done_results[seq] = (bref, ray_tpu.get(mref))
+            meta = ray_tpu.get(mref)
+            if meta.size_bytes > 0:
+                st._blocks_seen += 1
+                st.avg_block_bytes += (meta.size_bytes - st.avg_block_bytes) \
+                    / st._blocks_seen
+            st.done_results[seq] = (bref, meta)
             while st.emit_fifo and st.emit_fifo[0] in st.done_results:
                 st.output.append(st.done_results.pop(st.emit_fifo.popleft()))
 
@@ -434,6 +472,7 @@ def _shuffle(refs, n_out: int, seed) -> List[RefBundle]:
 
 @ray_tpu.remote
 def _sort_sample(block: Block, key: str):
+    block = BlockAccessor.to_numpy_block(block)  # dict-indexing kernel
     col = block[key]
     k = min(len(col), 64)
     if len(col) == 0:
@@ -444,6 +483,7 @@ def _sort_sample(block: Block, key: str):
 
 @ray_tpu.remote
 def _sort_map(block: Block, key: str, bounds):
+    block = BlockAccessor.to_numpy_block(block)  # dict-indexing kernel
     col = block[key]
     order = np.argsort(col, kind="stable")
     sorted_block = BlockAccessor.take_idx(block, order)
@@ -458,7 +498,7 @@ def _sort_map(block: Block, key: str, bounds):
 
 @ray_tpu.remote(num_returns=2)
 def _sort_reduce(j: int, key: str, descending: bool, *parts):
-    block = BlockAccessor.concat(list(parts))
+    block = BlockAccessor.to_numpy_block(BlockAccessor.concat(list(parts)))
     order = np.argsort(block.get(key, np.asarray([])), kind="stable") \
         if block else np.asarray([], dtype=int)
     block = BlockAccessor.take_idx(block, order) if block else block
@@ -494,6 +534,7 @@ def _sort(refs, metas, key: str, descending: bool) -> List[RefBundle]:
 
 @ray_tpu.remote
 def _hash_partition(block: Block, keys: List[str], n_out: int):
+    block = BlockAccessor.to_numpy_block(block)  # dict-indexing kernel
     n = BlockAccessor.num_rows(block)
     if n == 0:
         return block if n_out == 1 else tuple([block] * n_out)
@@ -529,7 +570,7 @@ def _hash_partition(block: Block, keys: List[str], n_out: int):
 def _agg_reduce(j: int, keys: List[str], aggs, *parts):
     from ray_tpu.data.aggregate import apply_aggs_to_groups
 
-    block = BlockAccessor.concat(list(parts))
+    block = BlockAccessor.to_numpy_block(BlockAccessor.concat(list(parts)))
     out = apply_aggs_to_groups(block, keys, aggs)
     return out, BlockAccessor.metadata(out)
 
@@ -552,7 +593,7 @@ def _groupby_agg(refs, keys, aggs) -> List[RefBundle]:
 def _map_groups_reduce(j: int, keys, fn, batch_format, *parts):
     from ray_tpu.data.block import format_batch
 
-    block = BlockAccessor.concat(list(parts))
+    block = BlockAccessor.to_numpy_block(BlockAccessor.concat(list(parts)))
     n = BlockAccessor.num_rows(block)
     outs = []
     if n:
@@ -585,6 +626,8 @@ def _map_groups(refs, keys, fn, batch_format) -> List[RefBundle]:
 
 @ray_tpu.remote(num_returns=2)
 def _zip_blocks(a: Block, b: Block):
+    a = BlockAccessor.to_numpy_block(a)
+    b = BlockAccessor.to_numpy_block(b)
     dup = set(a) & set(b)
     merged = dict(a)
     for k, v in b.items():

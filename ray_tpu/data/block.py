@@ -1,26 +1,43 @@
 """Columnar block model for ray_tpu.data.
 
-A *block* is the unit of data the streaming executor moves between tasks:
-a dict mapping column name -> numpy array, all with equal leading dimension.
-(Reference: python/ray/data/block.py — there a block is a pyarrow Table or
-pandas DataFrame.  Here the canonical representation is dict-of-numpy:
-numpy round-trips through the shared-memory object store zero-copy via
-pickle-5 out-of-band buffers, and it is the layout ``jax.device_put`` wants,
-so a block can go plasma -> host pinned buffer -> TPU without a row pivot.)
+A *block* is the unit of data the streaming executor moves between tasks.
+TWO representations are first-class (reference: python/ray/data/block.py,
+_internal/arrow_block.py — blocks are pyarrow Tables or pandas frames):
 
-Non-numeric python objects live in ``dtype=object`` columns, so arbitrary
-rows still fit the columnar frame.
+- dict[str, np.ndarray] — the TPU hand-off layout: round-trips the
+  shared-memory store zero-copy via pickle-5 buffers and feeds
+  ``jax.device_put`` without a pivot;
+- ``pyarrow.Table`` — schema-carrying columnar format; parquet reads stay
+  Arrow end-to-end through map_batches(batch_format="pyarrow") and
+  iter_batches(batch_format="pyarrow") with no numpy round-trip (arrow
+  buffers also pickle out-of-band, so plasma transport is zero-copy too).
+
+``BlockAccessor`` dispatches on the representation; all-to-all ops
+(sort/shuffle/groupby) pivot to numpy at their barrier, where a row pivot
+happens anyway.  Non-numeric python objects live in ``dtype=object``
+columns, so arbitrary rows still fit the columnar frame.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
 
-Block = Dict[str, np.ndarray]
+Block = Union[Dict[str, np.ndarray], "pyarrow.Table"]
 Row = Dict[str, Any]
+
+
+def is_arrow_block(block: Any) -> bool:
+    if isinstance(block, dict):
+        return False
+    try:
+        import pyarrow as pa
+
+        return isinstance(block, pa.Table)
+    except ImportError:
+        return False
 
 
 @dataclass
@@ -79,11 +96,13 @@ class BlockAccessor:
     def to_pandas(block: Block):
         import pandas as pd
 
+        if is_arrow_block(block):
+            return block.to_pandas()
         return pd.DataFrame({k: list(v) if v.ndim > 1 else v
                              for k, v in block.items()})
 
     @staticmethod
-    def from_arrow(table) -> Block:
+    def from_arrow(table) -> Dict[str, np.ndarray]:
         out = {}
         for name in table.column_names:
             col = table.column(name)
@@ -94,9 +113,18 @@ class BlockAccessor:
         return out
 
     @staticmethod
+    def to_numpy_block(block: Block) -> Dict[str, np.ndarray]:
+        """Canonical numpy view (the jax hand-off / all-to-all pivot)."""
+        if is_arrow_block(block):
+            return BlockAccessor.from_arrow(block)
+        return block
+
+    @staticmethod
     def to_arrow(block: Block):
         import pyarrow as pa
 
+        if is_arrow_block(block):
+            return block
         return pa.table({k: (list(v) if v.ndim > 1 or v.dtype.kind == "O"
                              else v)
                          for k, v in block.items()})
@@ -104,12 +132,16 @@ class BlockAccessor:
     # ------------------------------------------------------------ inspect
     @staticmethod
     def num_rows(block: Block) -> int:
+        if is_arrow_block(block):
+            return block.num_rows
         if not block:
             return 0
         return len(next(iter(block.values())))
 
     @staticmethod
     def size_bytes(block: Block) -> int:
+        if is_arrow_block(block):
+            return block.nbytes
         total = 0
         for v in block.values():
             if v.dtype.kind == "O":
@@ -122,6 +154,8 @@ class BlockAccessor:
 
     @staticmethod
     def schema(block: Block) -> Dict[str, str]:
+        if is_arrow_block(block):
+            return {f.name: str(f.type) for f in block.schema}
         out = {}
         for k, v in block.items():
             t = "object" if v.dtype.kind == "O" else str(v.dtype)
@@ -142,6 +176,8 @@ class BlockAccessor:
     # ------------------------------------------------------------ transform
     @staticmethod
     def slice(block: Block, start: int, end: int) -> Block:
+        if is_arrow_block(block):
+            return block.slice(start, max(end - start, 0))
         return {k: v[start:end] for k, v in block.items()}
 
     @staticmethod
@@ -151,6 +187,26 @@ class BlockAccessor:
             return {}
         if len(blocks) == 1:
             return blocks[0]
+        if all(is_arrow_block(b) for b in blocks):
+            import pyarrow as pa
+
+            first = blocks[0].schema
+            aligned = [blocks[0]]
+            for i, b in enumerate(blocks[1:], 1):
+                if b.schema != first:
+                    # same columns in a different order is fine (multi-file
+                    # reads don't guarantee order); anything else is a loud
+                    # error (reference: arrow_block schema unification)
+                    if set(b.schema.names) == set(first.names):
+                        b = b.select(first.names)
+                    if b.schema != first:
+                        raise ValueError(
+                            f"cannot concat Arrow blocks with mismatched "
+                            f"schemas:\n{first}\nvs (block {i}):\n{b.schema}")
+                aligned.append(b)
+            return pa.concat_tables(aligned)
+        if any(is_arrow_block(b) for b in blocks):
+            blocks = [BlockAccessor.to_numpy_block(b) for b in blocks]
         keys = list(blocks[0].keys())
         for i, b in enumerate(blocks[1:], 1):
             if set(b.keys()) != set(keys):
@@ -175,16 +231,29 @@ class BlockAccessor:
 
     @staticmethod
     def iter_rows(block: Block) -> Iterator[Row]:
+        if is_arrow_block(block):
+            yield from block.to_pylist()
+            return
         keys = list(block.keys())
         for i in range(BlockAccessor.num_rows(block)):
             yield {k: block[k][i] for k in keys}
 
     @staticmethod
     def take_idx(block: Block, idx: np.ndarray) -> Block:
+        if is_arrow_block(block):
+            import pyarrow as pa
+
+            return block.take(pa.array(np.asarray(idx)))
         return {k: v[idx] for k, v in block.items()}
 
     @staticmethod
     def select(block: Block, cols: Sequence[str]) -> Block:
+        if is_arrow_block(block):
+            missing = [c for c in cols if c not in block.column_names]
+            if missing:
+                raise KeyError(f"columns not in block: {missing}; "
+                               f"available: {block.column_names}")
+            return block.select(list(cols))
         missing = [c for c in cols if c not in block]
         if missing:
             raise KeyError(f"columns not in block: {missing}; "
@@ -193,11 +262,17 @@ class BlockAccessor:
 
     @staticmethod
     def drop(block: Block, cols: Sequence[str]) -> Block:
+        if is_arrow_block(block):
+            return block.drop_columns(
+                [c for c in cols if c in block.column_names])
         return {k: v for k, v in block.items() if k not in cols}
 
     @staticmethod
     def sort_key_array(block: Block, key: str, descending: bool = False):
-        col = block[key]
+        if is_arrow_block(block):
+            col = block.column(key).to_numpy(zero_copy_only=False)
+        else:
+            col = block[key]
         order = np.argsort(col, kind="stable")
         if descending:
             order = order[::-1]
@@ -205,7 +280,8 @@ class BlockAccessor:
 
     @staticmethod
     def normalize(batch: Any, what: str = "map_batches") -> Block:
-        """Coerce a user function's return value back into a block."""
+        """Coerce a user function's return value back into a block.  Arrow
+        tables pass THROUGH — a pyarrow pipeline stays Arrow end-to-end."""
         if batch is None:
             return {}
         if isinstance(batch, dict):
@@ -222,7 +298,7 @@ class BlockAccessor:
             import pyarrow as pa
 
             if isinstance(batch, pa.Table):
-                return BlockAccessor.from_arrow(batch)
+                return batch
         except ImportError:
             pass
         if isinstance(batch, list):
@@ -233,9 +309,14 @@ class BlockAccessor:
 
 
 def format_batch(block: Block, batch_format: Optional[str]):
-    """Present a block to user code in the requested format."""
+    """Present a block to user code in the requested format.
+
+    None/'default' mean dict-of-numpy — the TPU-first canonical layout and
+    the pre-Arrow behavior, so existing numpy-style UDFs keep working on
+    Arrow-sourced datasets.  Arrow stays Arrow only when asked for
+    ('pyarrow'), which is what keeps a parquet pipeline pivot-free."""
     if batch_format in (None, "numpy", "native", "default"):
-        return block
+        return BlockAccessor.to_numpy_block(block)
     if batch_format == "pandas":
         return BlockAccessor.to_pandas(block)
     if batch_format == "pyarrow":

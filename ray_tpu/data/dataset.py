@@ -103,8 +103,11 @@ class Dataset:
     def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
         return self._map_op(
             L.MapStage(kind="batches",
-                       fn=lambda b: {mapping.get(k, k): v
-                                     for k, v in b.items()}),
+                       fn=lambda b: (
+                           b.rename_columns(
+                               [mapping.get(k, k) for k in b.column_names])
+                           if not isinstance(b, dict)
+                           else {mapping.get(k, k): v for k, v in b.items()})),
             f"RenameColumns", None)
 
     def random_sample(self, fraction: float,
@@ -198,7 +201,11 @@ class Dataset:
 
     # ======================================================== consumption
     def iter_bundles(self) -> Iterator[RefBundle]:
-        yield from StreamingExecutor(self._plan).execute()
+        ex = StreamingExecutor(self._plan)
+        # exposed for stats/backpressure introspection (reference:
+        # Dataset.stats() reads the last executor's metrics)
+        self._last_executor = ex
+        yield from ex.execute()
 
     def iter_internal_blocks(self) -> Iterator[Block]:
         for ref, _meta in self.iter_bundles():

@@ -151,6 +151,14 @@ class FileDatasource(Datasource):
 
 class CSVDatasource(FileDatasource):
     def read_file(self, path):
+        try:
+            from pyarrow import csv as pa_csv
+
+            if not self.kwargs:  # pandas kwargs don't map onto pyarrow.csv
+                yield pa_csv.read_csv(path)
+                return
+        except ImportError:
+            pass
         import pandas as pd
 
         yield BlockAccessor.from_pandas(pd.read_csv(path, **self.kwargs))
@@ -174,7 +182,9 @@ class ParquetDatasource(FileDatasource):
     def read_file(self, path):
         import pyarrow.parquet as pq
 
-        yield BlockAccessor.from_arrow(pq.read_table(path, **self.kwargs))
+        # stays an Arrow table: schema-carrying blocks flow through
+        # map_batches(batch_format="pyarrow") / iter_batches with no pivot
+        yield pq.read_table(path, **self.kwargs)
 
 
 class NumpyDatasource(FileDatasource):
@@ -216,7 +226,8 @@ def write_block(block: Block, path_template: str, fmt: str, index: int,
             path, orient="records", lines=True, **kwargs)
     elif fmt == "numpy":
         column = kwargs.pop("column", None)
-        arr = block[column] if column else next(iter(block.values()))
+        nb = BlockAccessor.to_numpy_block(block)
+        arr = nb[column] if column else next(iter(nb.values()))
         np.save(path, arr)
     else:
         raise ValueError(f"unknown write format: {fmt}")

@@ -52,6 +52,7 @@ class DataIterator:
         import jax
 
         def put(batch: Block):
+            batch = BlockAccessor.to_numpy_block(batch)
             out = {}
             for k, v in batch.items():
                 if v.dtype.kind == "O":

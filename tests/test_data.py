@@ -279,3 +279,70 @@ def test_sort_all_empty_blocks(cluster):
     ds = rd.range(40, parallelism=4).filter(lambda r: False)
     assert ds.sort("id").take_all() == []
     assert ds.count() == 0
+
+
+def test_parquet_stays_arrow_end_to_end(cluster, tmp_path):
+    """VERDICT r3 #7 done bar: parquet -> map_batches -> iter_batches keeps
+    Arrow blocks (schema-carrying) with no numpy pivot."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(pa.table({"x": list(range(100)),
+                             "s": [f"r{i}" for i in range(100)]}), path)
+    ds = rd.read_parquet(path)
+
+    def double(t):
+        assert isinstance(t, pa.Table), f"expected Arrow, got {type(t)}"
+        return t.set_column(t.schema.get_field_index("x"), "x",
+                            pa.chunked_array([[v * 2 for v in
+                                               t.column("x").to_pylist()]]))
+
+    out = ds.map_batches(double, batch_format="pyarrow")
+    batches = list(out.iter_batches(batch_size=None,
+                                    batch_format="pyarrow"))
+    assert all(isinstance(b, pa.Table) for b in batches)
+    vals = [v for b in batches for v in b.column("x").to_pylist()]
+    assert sorted(vals) == [i * 2 for i in range(100)]
+    # schema survived
+    assert ds.schema()["s"] == "string"
+
+
+def test_arrow_concat_schema_mismatch_is_loud(cluster):
+    import pyarrow as pa
+
+    from ray_tpu.data.block import BlockAccessor
+
+    a = pa.table({"x": [1, 2]})
+    b = pa.table({"x": [1.5]})
+    with pytest.raises(ValueError, match="mismatched"):
+        BlockAccessor.concat([a, b])
+
+
+def test_memory_budget_backpressure(cluster):
+    """Blocks >> budget: the executor admits reads only as the consumer
+    drains; buffered bytes stay bounded near the budget."""
+    from ray_tpu.data._executor import DataContext
+
+    ctx = DataContext.get_current()
+    old = ctx.max_buffered_bytes
+    ctx.max_buffered_bytes = 4 * 1024 * 1024  # 4 MB budget
+    try:
+        # 16 blocks x ~2 MB = 32 MB total, 8x the budget
+        ds = rd.range(16 * 262_144, parallelism=16).map_batches(
+            lambda b: {"x": b["id"].astype(np.float64)})
+        it = ds.iter_batches(batch_size=None)
+        peaks = []
+        rows = 0
+        for b in it:
+            rows += len(b["x"])
+            peaks.append(ds._last_executor._buffered_bytes())
+        assert rows == 16 * 262_144  # everything still arrives
+        # bounded: budget + the admission burst that was in flight before
+        # the first real block sizes arrived (avg seeded at 1 MB, blocks
+        # are 2 MB) — far below the 32 MB the pipeline would otherwise
+        # buffer unthrottled
+        slack = 8 * 1024 * 1024
+        assert max(peaks) <= ctx.max_buffered_bytes + slack, max(peaks)
+    finally:
+        ctx.max_buffered_bytes = old
