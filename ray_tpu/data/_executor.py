@@ -184,19 +184,25 @@ class StreamingExecutor:
                 "tasks": st.tasks_launched,
                 "rows_out": max(st.rows_out, st.rows_emitted)}
 
+    _BARRIER_OPS = (L.Repartition, L.RandomShuffle, L.Sort, L.GroupByAgg,
+                    L.MapGroups, L.RandomizeBlockOrder, L.Zip, L.Union)
+
     def _buffered_bytes(self) -> int:
         """Bytes the pipeline currently holds: bundles queued in operator
         input/output deques PLUS an estimate for in-flight tasks (launched
         reads/maps land regardless of later admission decisions, so they
-        must count against the budget at admission time)."""
+        must count against the budget at admission time).  Barrier ops'
+        input is EXCLUDED: they materialize their whole input by design, so
+        counting it would gate the source forever (livelock) without making
+        the materialization any smaller."""
         total = 0
         for st in self.states:
             for item in st.output:
                 total += max(item[1].size_bytes, 0)
-            for item in st.input:
-                # Read ops queue ReadTasks here; bundles are (ref, meta)
-                if isinstance(item, tuple) and len(item) == 2 and \
-                        isinstance(item[1], BlockMetadata):
+            if not isinstance(st.op, self._BARRIER_OPS) and st.input and \
+                    isinstance(st.input[0], tuple):
+                # (Read ops queue ReadTasks, not (ref, meta) bundles)
+                for item in st.input:
                     total += max(item[1].size_bytes, 0)
             total += len(st.inflight) * st.avg_block_bytes
         return total
@@ -229,14 +235,27 @@ class StreamingExecutor:
             # The byte budget throttles SOURCES only: bytes enter the
             # pipeline here, and downstream operators must stay free to
             # drain what is already buffered (gating them too would
-            # deadlock once the budget trips).
+            # deadlock once the budget trips).  Computed once per pass —
+            # the admission burst it allows is bounded by the in-flight cap.
+            # Liveness override: if NOTHING is running anywhere, admitting
+            # one read is the only way the pipeline can make progress.
+            base_bytes = self._buffered_bytes()
+            admitted = 0
+            forced = False
+            if base_bytes >= ctx.max_buffered_bytes and st.input and \
+                    not any(s.inflight for s in self.states):
+                forced = True
             while (st.input and downstream_room
                    and len(st.inflight) < ctx.max_tasks_in_flight_per_op
-                   and self._buffered_bytes() < ctx.max_buffered_bytes):
+                   and (forced or base_bytes + admitted * st.avg_block_bytes
+                        < ctx.max_buffered_bytes)):
                 task = st.input.popleft()
                 bref, mref = _run_read_task.remote(task)
                 self._track(st, bref, mref)
+                admitted += 1
                 progressed = True
+                if forced:
+                    break  # liveness override admits exactly one read
                 downstream_room = len(st.output) < ctx.max_output_queue_blocks
         elif isinstance(op, L.InputBlocks):
             pass

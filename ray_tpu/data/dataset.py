@@ -103,11 +103,8 @@ class Dataset:
     def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
         return self._map_op(
             L.MapStage(kind="batches",
-                       fn=lambda b: (
-                           b.rename_columns(
-                               [mapping.get(k, k) for k in b.column_names])
-                           if not isinstance(b, dict)
-                           else {mapping.get(k, k): v for k, v in b.items()})),
+                       fn=lambda b: {mapping.get(k, k): v
+                                     for k, v in b.items()}),
             f"RenameColumns", None)
 
     def random_sample(self, fraction: float,

@@ -151,14 +151,9 @@ class FileDatasource(Datasource):
 
 class CSVDatasource(FileDatasource):
     def read_file(self, path):
-        try:
-            from pyarrow import csv as pa_csv
-
-            if not self.kwargs:  # pandas kwargs don't map onto pyarrow.csv
-                yield pa_csv.read_csv(path)
-                return
-        except ImportError:
-            pass
+        # stays on pandas: pyarrow.csv infers different dtypes (e.g. date
+        # columns), which would silently change existing pipelines; the
+        # Arrow-native path is parquet
         import pandas as pd
 
         yield BlockAccessor.from_pandas(pd.read_csv(path, **self.kwargs))

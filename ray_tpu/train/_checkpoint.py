@@ -20,29 +20,10 @@ import tempfile
 from typing import Iterator, Optional
 
 
-class _LocalFileSystem:
-    """Default storage backend: plain local paths (NFS/gcsfuse included)."""
-
-    def merge_dir(self, local: str, remote: str) -> None:
-        """Copy contents into ``remote`` without removing what's there —
-        used when several ranks contribute to one checkpoint dir."""
-        os.makedirs(remote, exist_ok=True)
-        shutil.copytree(local, remote, dirs_exist_ok=True)
-
-    def download_dir(self, remote: str, local: str) -> None:
-        shutil.copytree(remote, local, dirs_exist_ok=True)
-
-    def exists(self, path: str) -> bool:
-        return os.path.exists(path)
-
-    def delete_dir(self, path: str) -> None:
-        shutil.rmtree(path, ignore_errors=True)
-
-    def listdir(self, path: str):
-        return os.listdir(path)
-
-
-_DEFAULT_FS = _LocalFileSystem()
+# One filesystem abstraction for the whole train/tune stack: the local
+# backend lives in ray_tpu.train.storage (URI backends resolve there too).
+from ray_tpu.train.storage import _LocalFS as _LocalFileSystem  # noqa: E402
+from ray_tpu.train.storage import _LOCAL as _DEFAULT_FS  # noqa: E402
 
 
 class Checkpoint:
@@ -71,17 +52,26 @@ class Checkpoint:
     def from_directory(cls, path: str) -> "Checkpoint":
         return cls(os.path.abspath(path))
 
+    @classmethod
+    def from_uri(cls, uri: str) -> "Checkpoint":
+        """A checkpoint living in remote storage (gs://, s3://, ...)."""
+        return cls(uri)
+
     @contextlib.contextmanager
     def as_directory(self) -> Iterator[str]:
         """Yield a local directory with the checkpoint contents.  If the
         checkpoint already lives on a local path, yields it directly (no
         copy); otherwise downloads to a temp dir cleaned up on exit."""
-        if isinstance(self.filesystem, _LocalFileSystem) and os.path.isdir(self.path):
+        from ray_tpu.train import storage
+
+        if not storage.is_uri(self.path) and \
+                isinstance(self.filesystem, _LocalFileSystem) and \
+                os.path.isdir(self.path):
             yield self.path
             return
         tmp = tempfile.mkdtemp(prefix="rtpu-ckpt-")
         try:
-            self.filesystem.download_dir(self.path, tmp)
+            self._download(tmp)
             yield tmp
         finally:
             shutil.rmtree(tmp, ignore_errors=True)
@@ -89,8 +79,16 @@ class Checkpoint:
     def to_directory(self, path: Optional[str] = None) -> str:
         """Materialize into ``path`` (or a fresh temp dir) and return it."""
         target = path or tempfile.mkdtemp(prefix="rtpu-ckpt-")
-        self.filesystem.download_dir(self.path, target)
+        self._download(target)
         return target
+
+    def _download(self, target: str) -> None:
+        from ray_tpu.train import storage
+
+        if storage.is_uri(self.path):
+            storage.download_dir(self.path, target)
+        else:
+            self.filesystem.download_dir(self.path, target)
 
     def __repr__(self):
         return f"Checkpoint({self.path!r})"

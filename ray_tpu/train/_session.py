@@ -122,18 +122,24 @@ class _TrainSession:
         self._consumed.acquire()  # lockstep with the driver (reference :403)
 
     def _persist_checkpoint(self, checkpoint: Checkpoint) -> str:
+        from ray_tpu.train import storage
+
         seq = self._checkpoint_seq
         self._checkpoint_seq += 1
-        ckpt_dir = os.path.join(self.context.trial_dir, f"checkpoint_{seq:06d}")
+        ckpt_dir = storage.join(self.context.trial_dir,
+                                f"checkpoint_{seq:06d}")
         # Rank 0's files are the canonical checkpoint contents; nonzero ranks
         # (sharded/model-parallel state) land in rank_<k>/ subdirs.  Merge
         # (never replace) so concurrent rank uploads don't clobber each other;
         # completeness is recorded by the driver in progress.json only after
         # every rank's report round-trips, so a crash mid-upload can never
-        # yield a trusted half-checkpoint.
-        target = ckpt_dir if self.context.world_rank == 0 else os.path.join(
+        # yield a trusted half-checkpoint.  The target may be a remote URI
+        # (RunConfig(storage_path="gs://...")): TPU-VM disks die with the
+        # slice, so durable checkpoints must leave the host.
+        target = ckpt_dir if self.context.world_rank == 0 else storage.join(
             ckpt_dir, f"rank_{self.context.world_rank}")
-        checkpoint.filesystem.merge_dir(checkpoint.path, target)
+        with checkpoint.as_directory() as local:
+            storage.merge_dir(local, target)
         return ckpt_dir
 
     # ---------------------------------------------------- actor side

@@ -23,6 +23,7 @@ import cloudpickle
 from ray_tpu.air.config import RunConfig, ScalingConfig
 from ray_tpu.air.result import Result
 from ray_tpu.train._backend_executor import BackendExecutor, TrainingFailedError
+from ray_tpu.train import storage
 from ray_tpu.train._checkpoint import Checkpoint
 from ray_tpu.train.jax_config import BackendConfig
 
@@ -60,16 +61,17 @@ class BaseTrainer:
     # --------------------------------------------------------- restoration
     @classmethod
     def can_restore(cls, path: str) -> bool:
-        return os.path.exists(os.path.join(os.path.expanduser(path), _TRAINER_PKL))
+        return storage.exists(
+            storage.join(storage.expand(path), _TRAINER_PKL))
 
     @classmethod
     def restore(cls, path: str, **overrides) -> "BaseTrainer":
         """Rebuild a trainer from a trial dir written by a previous fit();
         training resumes from the latest complete checkpoint (reference:
         base_trainer.py restore/can_restore)."""
-        path = os.path.expanduser(path)
-        with open(os.path.join(path, _TRAINER_PKL), "rb") as f:
-            state = cloudpickle.load(f)
+        path = storage.expand(path)
+        state = cloudpickle.loads(
+            storage.read_bytes(storage.join(path, _TRAINER_PKL)))
         trainer: BaseTrainer = state["trainer"]
         for k, v in overrides.items():
             if v is not None:
@@ -85,17 +87,18 @@ class BaseTrainer:
     # ------------------------------------------------------------- plumbing
     @property
     def trial_dir(self) -> str:
-        return os.path.join(os.path.expanduser(self.run_config.storage_path),
+        return storage.join(storage.expand(self.run_config.storage_path),
                             self.run_config.name)
 
     def _save_trainer_state(self) -> None:
-        os.makedirs(self.trial_dir, exist_ok=True)
-        with open(os.path.join(self.trial_dir, _TRAINER_PKL), "wb") as f:
-            cloudpickle.dump({
+        storage.makedirs(self.trial_dir)
+        storage.write_bytes(
+            storage.join(self.trial_dir, _TRAINER_PKL),
+            cloudpickle.dumps({
                 "trainer": self,
                 "name": self.run_config.name,
                 "storage_path": self.run_config.storage_path,
-            }, f)
+            }))
 
     def training_loop(self) -> Result:
         """One attempt; subclasses implement.  Retries are the caller's job
@@ -108,7 +111,7 @@ def _next_checkpoint_seq(trial_dir: str) -> int:
     fresh state into a stale same-numbered dir."""
     seqs = []
     try:
-        for d in os.listdir(trial_dir):
+        for d in storage.listdir(trial_dir):
             if d.startswith("checkpoint_"):
                 try:
                     seqs.append(int(d.split("_", 1)[1]))
@@ -123,16 +126,13 @@ def latest_checkpoint(trial_dir: str) -> Optional[str]:
     """The newest checkpoint recorded COMPLETE in progress.json (written by
     the driver only after every rank's report round-tripped) — scanning the
     filesystem would trust half-written dirs."""
-    progress = os.path.join(trial_dir, _PROGRESS_JSON)
-    if not os.path.exists(progress):
-        return None
+    progress = storage.join(trial_dir, _PROGRESS_JSON)
     try:
-        with open(progress) as f:
-            data = json.load(f)
-    except (OSError, json.JSONDecodeError):
+        data = json.loads(storage.read_bytes(progress))
+    except (OSError, FileNotFoundError, json.JSONDecodeError):
         return None
     path = data.get("latest_checkpoint")
-    return path if path and os.path.exists(path) else None
+    return path if path and storage.exists(path) else None
 
 
 class DataParallelTrainer(BaseTrainer):
@@ -166,7 +166,7 @@ class DataParallelTrainer(BaseTrainer):
         """Reference: data_parallel_trainer.py:362 _run_training — but the
         executor lives on the driver side of the trial."""
         trial_dir = self.trial_dir
-        os.makedirs(trial_dir, exist_ok=True)
+        storage.makedirs(trial_dir)
         self._save_trainer_state()
 
         executor = BackendExecutor(self.backend_config, self.scaling_config)
@@ -225,27 +225,23 @@ class DataParallelTrainer(BaseTrainer):
         )
 
     def _write_progress(self, trial_dir: str, ckpt: str, metrics) -> None:
-        tmp = os.path.join(trial_dir, _PROGRESS_JSON + ".tmp")
-        with open(tmp, "w") as f:
-            json.dump({"latest_checkpoint": ckpt,
-                       "metrics": _jsonable(metrics),
-                       "time": time.time()}, f)
-        os.replace(tmp, os.path.join(trial_dir, _PROGRESS_JSON))
+        storage.write_bytes(
+            storage.join(trial_dir, _PROGRESS_JSON),
+            json.dumps({"latest_checkpoint": ckpt,
+                        "metrics": _jsonable(metrics),
+                        "time": time.time()}).encode())
 
     def _apply_retention(self, trial_dir: str, latest: str) -> None:
         keep = self.run_config.checkpoint_config.num_to_keep
         if keep is None:
             return
         ckpts = sorted(
-            d for d in os.listdir(trial_dir)
-            if d.startswith("checkpoint_")
-            and os.path.isdir(os.path.join(trial_dir, d)))
+            d for d in storage.listdir(trial_dir)
+            if d.startswith("checkpoint_"))
         for d in ckpts[:-keep]:
-            full = os.path.join(trial_dir, d)
+            full = storage.join(trial_dir, d)
             if full != latest:
-                import shutil
-
-                shutil.rmtree(full, ignore_errors=True)
+                storage.rmtree(full)
 
 
 def _jsonable(obj):

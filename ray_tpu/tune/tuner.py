@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 
 import ray_tpu
 from ray_tpu.air.config import RunConfig
+from ray_tpu.train import storage as _storage
 from ray_tpu.air.result import Result
 from ray_tpu.exceptions import RayError
 from ray_tpu.tune.search import generate_variants
@@ -112,7 +113,7 @@ def _run_function_trial(fn: Callable, config: Dict[str, Any],
     to the controller and cooperative early-stop works (ASHA/PBT)."""
     from ray_tpu.tune import session as tune_session
 
-    os.makedirs(trial_dir, exist_ok=True)
+    _storage.makedirs(trial_dir)
     sess = None
     if coordinator is not None:
         sess = tune_session._TuneSession(coordinator, trial_index)
@@ -169,10 +170,10 @@ class Tuner:
         is_trainer = isinstance(self._trainable, BaseTrainer)
         variants = generate_variants(self._param_space,
                                      self._tune_config.num_samples)
-        exp_dir = os.path.join(
-            os.path.expanduser(self._run_config.storage_path),
+        exp_dir = _storage.join(
+            _storage.expand(self._run_config.storage_path),
             self._run_config.name)
-        os.makedirs(exp_dir, exist_ok=True)
+        _storage.makedirs(exp_dir)
         trials = [
             Trial(index=i, config=v, name=f"trial_{i:05d}")
             for i, v in enumerate(variants)
@@ -222,7 +223,7 @@ class Tuner:
             if is_trainer:
                 return tr_task.remote(trainer_blob, trial.config, trial.name)
             return fn_task.remote(self._trainable, trial.config,
-                                  os.path.join(exp_dir, trial.name),
+                                  _storage.join(exp_dir, trial.name),
                                   coordinator, trial.index, start_checkpoint)
 
         by_index = {t.index: t for t in trials}
@@ -284,7 +285,7 @@ class Tuner:
                     trial.error = repr(e)
                     trial.result = Result(
                         metrics={"config": trial.config}, error=e,
-                        path=os.path.join(exp_dir, trial.name))
+                        path=_storage.join(exp_dir, trial.name))
                 self._snapshot(exp_dir, trials)
                 continue
             trial.status = "TERMINATED"
@@ -308,21 +309,20 @@ class Tuner:
             else:
                 trial.result = Result(
                     metrics={**out, "config": trial.config},
-                    path=os.path.join(exp_dir, trial.name))
+                    path=_storage.join(exp_dir, trial.name))
             self._snapshot(exp_dir, trials)
 
         return ResultGrid([t.result for t in trials],
                           self._tune_config.metric, self._tune_config.mode)
 
     def _snapshot(self, exp_dir: str, trials: List[Trial]) -> None:
-        tmp = os.path.join(exp_dir, "tuner_state.json.tmp")
-        with open(tmp, "w") as f:
-            json.dump({
+        _storage.write_bytes(
+            _storage.join(exp_dir, "tuner_state.json"),
+            json.dumps({
                 "time": time.time(),
                 "trials": [{
                     "name": t.name, "status": t.status,
                     "num_failures": t.num_failures, "error": t.error,
                     "config": {k: repr(v) for k, v in t.config.items()},
                 } for t in trials],
-            }, f, indent=2)
-        os.replace(tmp, os.path.join(exp_dir, "tuner_state.json"))
+            }, indent=2).encode())
