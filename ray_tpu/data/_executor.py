@@ -290,9 +290,7 @@ class StreamingExecutor:
                 progressed = True
             if st.rows_out >= op.limit:
                 st.done = True
-        elif isinstance(op, (L.Repartition, L.RandomShuffle, L.Sort,
-                             L.GroupByAgg, L.MapGroups, L.RandomizeBlockOrder,
-                             L.Zip, L.Union)):
+        elif isinstance(op, self._BARRIER_OPS):
             # Barrier ops: wait for the full input, then run.
             if st.upstream_done and not st.inflight:
                 bundles = list(st.input)

@@ -159,13 +159,25 @@ def run(target: Application, *, name: str = "default",
     return handle
 
 
-def start(http_host: str = "127.0.0.1", http_port: int = 8000) -> int:
-    """Ensure the HTTP proxy is up; returns the bound port."""
+def start(http_host: str = "127.0.0.1", http_port: int = 8000,
+          grpc_port: Optional[int] = None) -> int:
+    """Ensure the proxy is up; returns the bound HTTP port.  Pass
+    ``grpc_port`` (0 = ephemeral) to also serve the gRPC ingress
+    (reference: gRPCProxy, proxy.py:545); read the bound gRPC port with
+    ``grpc_ingress_port()``."""
     from ray_tpu.serve._controller import get_controller
 
     ctrl = get_controller(create=True)
-    return ray_tpu.get(ctrl.ensure_proxy.remote(http_host, http_port),
-                       timeout=60)
+    return ray_tpu.get(
+        ctrl.ensure_proxy.remote(http_host, http_port, grpc_port),
+        timeout=60)
+
+
+def grpc_ingress_port() -> Optional[int]:
+    """The bound gRPC ingress port, or None when gRPC is not enabled."""
+    from ray_tpu.serve._controller import get_controller
+
+    return ray_tpu.get(get_controller().proxy_grpc_port.remote(), timeout=30)
 
 
 def delete(name: str) -> None:

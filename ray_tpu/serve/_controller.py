@@ -134,14 +134,25 @@ class ServeController:
                 for app, deps in self._apps.items()
             }
 
-    def ensure_proxy(self, host: str, port: int) -> int:
+    def ensure_proxy(self, host: str, port: int,
+                     grpc_port=None) -> int:
         if self._proxy is None:
             from ray_tpu.serve._proxy import ProxyActor
 
-            self._proxy = ProxyActor.options(num_cpus=0).remote(host, port)
+            self._proxy = ProxyActor.options(num_cpus=0).remote(
+                host, port, grpc_port)
             self._proxy_port = ray_tpu.get(self._proxy.ready.remote(),
                                            timeout=60)
+        elif grpc_port is not None:
+            # proxy already up without gRPC: upgrade it in place rather than
+            # silently ignoring the documented parameter
+            ray_tpu.get(self._proxy.enable_grpc.remote(grpc_port), timeout=60)
         return self._proxy_port
+
+    def proxy_grpc_port(self):
+        if self._proxy is None:
+            return None
+        return ray_tpu.get(self._proxy.grpc_port.remote(), timeout=30)
 
     # ---------------------------------------------------------- reconcile
     def _reconcile_loop(self):

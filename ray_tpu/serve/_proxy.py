@@ -1,10 +1,17 @@
-"""HTTP proxy: routes requests to application ingress deployments.
+"""HTTP + gRPC proxies: route requests to application ingress deployments.
 
-Reference: python/ray/serve/_private/proxy.py (HTTPProxy :766, ProxyActor
-:1139), condensed to the aiohttp equivalent: longest-prefix route match,
-JSON/text body handling, handle-based fan-in to replicas.  gRPC ingress is
-out of scope (the reference's gRPCProxy); the Python handle path covers
-in-cluster composition.
+Reference: python/ray/serve/_private/proxy.py (HTTPProxy :766, gRPCProxy
+:545, ProxyActor :1139), condensed: longest-prefix HTTP route match,
+JSON/text body handling, and a proto-less gRPC ingress — a generic handler
+accepts ``/{application}/{Method}`` unary calls with raw request bytes, so
+any grpc client can call a deployment without compiled stubs::
+
+    ch = grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
+    call = ch.unary_unary("/myapp/Predict")   # bytes in, bytes out
+    reply = call(b"payload")
+
+Both ingresses share the same DeploymentHandle cache, so HTTP and gRPC
+traffic flow through ONE power-of-two-choices router per (app, ingress).
 """
 
 from __future__ import annotations
@@ -21,14 +28,17 @@ logger = logging.getLogger(__name__)
 
 @ray_tpu.remote(num_cpus=0)
 class ProxyActor:
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, grpc_port: Optional[int] = None):
         self._host = host
         self._port = port
+        self._grpc_port = grpc_port
+        self._grpc_server = None
         self._site = None
         self._handles: Dict[str, object] = {}
 
     async def ready(self) -> int:
-        """Start the aiohttp server; returns the bound port."""
+        """Start the aiohttp server (and the gRPC server when configured);
+        returns the bound HTTP port."""
         if self._site is not None:
             return self._port
         from aiohttp import web
@@ -45,7 +55,64 @@ class ProxyActor:
             self._port = sock.getsockname()[1]
             break
         logger.info("serve proxy listening on %s:%d", self._host, self._port)
+        if self._grpc_port is not None:
+            await self._start_grpc()
         return self._port
+
+    async def grpc_port(self) -> Optional[int]:
+        return self._grpc_port
+
+    async def enable_grpc(self, grpc_port: int) -> int:
+        """Start the gRPC ingress on an already-running proxy."""
+        if self._grpc_server is None:
+            self._grpc_port = grpc_port
+            await self._start_grpc()
+        return self._grpc_port
+
+    # ------------------------------------------------------------- gRPC
+    async def _start_grpc(self) -> None:
+        import grpc
+
+        proxy = self
+
+        class _Generic(grpc.GenericRpcHandler):
+            def service(self, details):
+                method = details.method  # "/{app}/{Method}"
+
+                async def unary(request: bytes, context):
+                    return await proxy._grpc_call(method, request, context)
+
+                return grpc.unary_unary_rpc_method_handler(unary)
+
+        server = grpc.aio.server()
+        server.add_generic_rpc_handlers((_Generic(),))
+        self._grpc_port = server.add_insecure_port(
+            f"{self._host}:{self._grpc_port}")
+        await server.start()
+        self._grpc_server = server
+        logger.info("serve gRPC ingress listening on %s:%d",
+                    self._host, self._grpc_port)
+
+    async def _grpc_call(self, method: str, request: bytes, context) -> bytes:
+        import grpc
+
+        parts = method.strip("/").split("/", 1)
+        app_name = parts[0]
+        loop = asyncio.get_event_loop()
+        try:
+            out = await loop.run_in_executor(
+                None, self._call_app, app_name, request)
+        except LookupError:
+            await context.abort(grpc.StatusCode.NOT_FOUND,
+                                f"no application {app_name!r}")
+        except Exception as e:
+            await context.abort(grpc.StatusCode.INTERNAL,
+                                f"{type(e).__name__}: {e}")
+        if isinstance(out, bytes):
+            return out
+        if isinstance(out, str):
+            return out.encode()
+        return json.dumps(out).encode()
 
     async def _handle(self, request):
         """aiohttp handler — runs on the worker's IO loop, so everything that
@@ -79,7 +146,6 @@ class ProxyActor:
 
     def _route_and_call(self, path: str, body):
         from ray_tpu.serve._controller import get_controller
-        from ray_tpu.serve.handle import DeploymentHandle
 
         ctrl = get_controller()
         routes = ray_tpu.get(ctrl.get_routes.remote(), timeout=30)
@@ -92,10 +158,20 @@ class ProxyActor:
                     best = (prefix, app_name)
         if best is None:
             raise LookupError(path)
-        app_name = best[1]
+        return self._call_app(best[1], body)
+
+    def _call_app(self, app_name: str, body):
+        """Shared HTTP/gRPC fan-in: one handle (one pow-2 router) per
+        (app, ingress) regardless of which ingress the request used."""
+        from ray_tpu.serve._controller import get_controller
+        from ray_tpu.serve.handle import DeploymentHandle
+
+        ctrl = get_controller()
         # keyed by (app, ingress): a redeploy can change the ingress
         # deployment, and a handle cached on app name alone would route 500s
         ingress = ray_tpu.get(ctrl.get_ingress.remote(app_name), timeout=30)
+        if ingress is None:
+            raise LookupError(app_name)
         key = (app_name, ingress)
         handle = self._handles.get(key)
         if handle is None:

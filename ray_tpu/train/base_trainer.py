@@ -129,7 +129,7 @@ def latest_checkpoint(trial_dir: str) -> Optional[str]:
     progress = storage.join(trial_dir, _PROGRESS_JSON)
     try:
         data = json.loads(storage.read_bytes(progress))
-    except (OSError, FileNotFoundError, json.JSONDecodeError):
+    except (OSError, json.JSONDecodeError):
         return None
     path = data.get("latest_checkpoint")
     return path if path and storage.exists(path) else None

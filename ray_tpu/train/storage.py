@@ -220,20 +220,44 @@ def download_dir(source: str, local: str) -> None:
 
 
 def get_fs(path: str) -> Tuple[StorageFS, str]:
-    """Resolve a storage path/URI to (filesystem, path-on-that-fs)."""
+    """Resolve a storage path/URI to (filesystem, path-on-that-fs).  The
+    filesystem object is cached per scheme+authority: rebuilding a GCS
+    client (connections, credentials) per checkpoint write would tax every
+    report round."""
     path = str(path)
     if not is_uri(path):
         return _LOCAL, os.path.expanduser(path)
-    import pyarrow as pa
+    from urllib.parse import urlparse
+
+    parsed = urlparse(path)
+    fs = _cached_uri_fs(parsed.scheme, parsed.netloc)
     import pyarrow.fs as pafs
 
     try:
-        fs, fs_path = pafs.FileSystem.from_uri(path)
+        _, fs_path = pafs.FileSystem.from_uri(path)
+    except Exception:
+        import fsspec
+
+        _, fs_path = fsspec.core.url_to_fs(path)
+    return fs, fs_path
+
+
+import functools  # noqa: E402
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_uri_fs(scheme: str, netloc: str) -> "StorageFS":
+    import pyarrow as pa
+    import pyarrow.fs as pafs
+
+    sample_uri = f"{scheme}://{netloc}/"
+    try:
+        fs, _ = pafs.FileSystem.from_uri(sample_uri)
     except (pa.lib.ArrowInvalid, OSError, ValueError):
         # schemes pyarrow doesn't speak natively (memory://, mock buckets in
         # tests, any fsspec backend)
         import fsspec
 
-        fsspec_fs, fs_path = fsspec.core.url_to_fs(path)
+        fsspec_fs, _ = fsspec.core.url_to_fs(sample_uri)
         fs = pafs.PyFileSystem(pafs.FSSpecHandler(fsspec_fs))
-    return _ArrowFS(fs), fs_path
+    return _ArrowFS(fs)

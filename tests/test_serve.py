@@ -185,3 +185,48 @@ def test_autoscaling_up(serve_instance):
     assert [r.result(120) for r in resps] == list(range(24))
     assert grew, "deployment never scaled up under load"
     serve.delete("auto")
+
+
+def test_grpc_ingress_shares_router(serve_instance):
+    """A deployment answers over BOTH HTTP and gRPC through the same pow-2
+    router (reference: gRPCProxy, _private/proxy.py:545).  The gRPC ingress
+    is proto-less: unary calls to /{app}/{Method} carry raw bytes."""
+    import json
+    import urllib.request
+
+    import grpc
+
+    @serve.deployment
+    class Echo:
+        def __call__(self, body):
+            if isinstance(body, (bytes, bytearray)):
+                return b"grpc:" + bytes(body)
+            return {"http": body}
+
+    serve.run(Echo.bind(), name="echoapp", route_prefix="/echoapp")
+    http_port = serve.start(http_port=0, grpc_port=0)
+    grpc_port = serve.grpc_ingress_port()
+    assert grpc_port
+
+    # gRPC path
+    ch = grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
+    call = ch.unary_unary("/echoapp/Predict")
+    assert call(b"hello", timeout=30) == b"grpc:hello"
+
+    # HTTP path against the SAME deployment
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{http_port}/echoapp", method="POST",
+        data=json.dumps({"k": 2}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert json.loads(resp.read()) == {"http": {"k": 2}}
+
+    # unknown app over gRPC -> NOT_FOUND
+    bad = ch.unary_unary("/nosuchapp/Predict")
+    try:
+        bad(b"x", timeout=30)
+        assert False, "expected NOT_FOUND"
+    except grpc.RpcError as e:
+        assert e.code() == grpc.StatusCode.NOT_FOUND
+    ch.close()
+    serve.delete("echoapp")
