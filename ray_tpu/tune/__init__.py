@@ -11,10 +11,11 @@ from ray_tpu.tune.search import (
 from ray_tpu.tune.schedulers import (ASHAScheduler, FIFOScheduler,
                                      PopulationBasedTraining)
 from ray_tpu.tune.session import get_checkpoint, report
+from ray_tpu.tune.search import TPESearcher
 from ray_tpu.tune.tuner import ResultGrid, Trial, TuneConfig, Tuner
 
 __all__ = [
-    "Tuner", "TuneConfig", "Trial", "ResultGrid",
+    "Tuner", "TuneConfig", "Trial", "ResultGrid", "TPESearcher",
     "grid_search", "choice", "uniform", "loguniform", "randint",
     "run_trainer_as_single_trial", "report", "get_checkpoint",
     "FIFOScheduler", "ASHAScheduler", "PopulationBasedTraining",

@@ -41,6 +41,10 @@ class TuneConfig:
     mode: str = "max"
     trial_resources: Dict[str, float] = field(default_factory=lambda: {"CPU": 1.0})
     scheduler: Optional[Any] = None  # FIFOScheduler | ASHAScheduler | PBT
+    # Model-based searcher (e.g. search.TPESearcher): suggests each trial's
+    # config from completed results instead of sampling independently
+    # (reference: tune/search/ searchers).
+    search_alg: Optional[Any] = None
 
     def __post_init__(self):
         if self.mode not in ("max", "min"):
@@ -168,8 +172,16 @@ class Tuner:
         from ray_tpu.train.base_trainer import BaseTrainer
 
         is_trainer = isinstance(self._trainable, BaseTrainer)
-        variants = generate_variants(self._param_space,
-                                     self._tune_config.num_samples)
+        searcher = self._tune_config.search_alg
+        if searcher is not None:
+            # model-based search: configs are suggested lazily at submit
+            # time so each suggestion sees every completed result
+            searcher.setup(self._param_space, self._tune_config.metric,
+                           self._tune_config.mode)
+            variants = [None] * max(self._tune_config.num_samples, 1)
+        else:
+            variants = generate_variants(self._param_space,
+                                         self._tune_config.num_samples)
         exp_dir = _storage.join(
             _storage.expand(self._run_config.storage_path),
             self._run_config.name)
@@ -260,10 +272,13 @@ class Tuner:
 
     def _drive(self, trials, pending, running, submit, pump_scheduler,
                scheduler, exp_dir, is_trainer, max_failures, wait_timeout):
+        searcher = self._tune_config.search_alg
         while pending or running:
             while pending and len(running) < \
                     self._tune_config.max_concurrent_trials:
                 t = pending.pop(0)
+                if t.config is None:
+                    t.config = searcher.suggest()
                 ckpt = t.config.pop("__pbt_checkpoint__", None)
                 running[submit(t, ckpt)] = t
             ready, _ = ray_tpu.wait(list(running), num_returns=1,
@@ -289,6 +304,10 @@ class Tuner:
                 self._snapshot(exp_dir, trials)
                 continue
             trial.status = "TERMINATED"
+            if searcher is not None and isinstance(out, dict):
+                final = out.get("_metrics", out) if is_trainer else out
+                searcher.on_trial_complete(
+                    trial.config, (final or {}).get(self._tune_config.metric))
             decision = scheduler.on_trial_complete(
                 trial, out if isinstance(out, dict) else None)
             if decision is not None and decision[0] == "restart":
@@ -323,6 +342,8 @@ class Tuner:
                 "trials": [{
                     "name": t.name, "status": t.status,
                     "num_failures": t.num_failures, "error": t.error,
-                    "config": {k: repr(v) for k, v in t.config.items()},
+                    # config is None until a model-based searcher suggests it
+                    "config": {k: repr(v)
+                               for k, v in (t.config or {}).items()},
                 } for t in trials],
             }, indent=2).encode())

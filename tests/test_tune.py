@@ -202,3 +202,67 @@ def test_pbt_exploits_and_restarts(ray_start_regular, tmp_path):
     warm = [r for r in results if r.metrics.get("warm")]
     assert warm, "PBT never restarted a trial from a donor checkpoint"
     assert all(r.metrics["lr"] >= 5.0 for r in warm)
+
+
+def test_tpe_beats_random_search():
+    """VERDICT r3 #10 done bar: the TPE searcher finds a better optimum
+    than random search on a seeded 2-param toy objective, same budget."""
+    import random as pyrandom
+
+    from ray_tpu.tune.search import TPESearcher, uniform
+
+    def objective(cfg):
+        return -((cfg["x"] - 0.3) ** 2 + (cfg["y"] + 0.7) ** 2)
+
+    space = {"x": uniform(-2.0, 2.0), "y": uniform(-2.0, 2.0)}
+    budget = 60
+
+    def run_tpe(seed):
+        s = TPESearcher(n_initial=10, seed=seed)
+        s.setup(space, metric="score", mode="max")
+        best = -float("inf")
+        for _ in range(budget):
+            cfg = s.suggest()
+            score = objective(cfg)
+            s.on_trial_complete(cfg, score)
+            best = max(best, score)
+        return best
+
+    def run_random(seed):
+        rng = pyrandom.Random(seed)
+        best = -float("inf")
+        for _ in range(budget):
+            cfg = {k: v.sample(rng) for k, v in space.items()}
+            best = max(best, objective(cfg))
+        return best
+
+    tpe_scores = [run_tpe(s) for s in range(5)]
+    rnd_scores = [run_random(s) for s in range(5)]
+    # TPE concentrates samples near the optimum: its MEAN best must beat
+    # random's mean best on the same seeds/budget
+    assert sum(tpe_scores) / 5 > sum(rnd_scores) / 5, (tpe_scores, rnd_scores)
+
+
+def test_tpe_through_tuner(ray_start_regular, tmp_path):
+    """search_alg wiring: the Tuner asks the searcher for configs and
+    reports results back; later suggestions exploit earlier scores."""
+    from ray_tpu import tune
+    from ray_tpu.tune.search import TPESearcher, uniform
+
+    def trainable(config):
+        return {"score": -((config["x"] - 1.0) ** 2)}
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": uniform(-3.0, 3.0)},
+        tune_config=tune.TuneConfig(
+            num_samples=25, metric="score", mode="max",
+            max_concurrent_trials=2,
+            search_alg=TPESearcher(n_initial=8, seed=3)),
+        run_config=RunConfig(name="tpe", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["score"] > -0.5, best.metrics
+    # the searcher observed every completed trial
+    assert len(tuner._tune_config.search_alg._obs) == 25
