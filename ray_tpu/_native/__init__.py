@@ -1,0 +1,94 @@
+"""Native (C++) runtime components, built lazily with the system toolchain.
+
+The reference's runtime core is C++ (SURVEY §2.1); here the Python control
+plane is the design, but latency-critical data-plane pieces get native
+implementations with graceful pure-Python fallback.  First use compiles the
+shared library with g++ into this directory (cached; flock'd against
+concurrent builders); any failure — no compiler, read-only install — just
+leaves the Python path in place.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "channel.cpp")
+_SO = os.path.join(_DIR, "libchannel.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    import fcntl
+
+    lockfile = os.path.join(_DIR, ".build.lock")
+    try:
+        with open(lockfile, "w") as lf:
+            fcntl.flock(lf, fcntl.LOCK_EX)
+            if os.path.exists(_SO) and \
+                    os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+                return True
+            proc = subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                 "-o", _SO + ".tmp", _SRC],
+                capture_output=True, text=True, timeout=120)
+            if proc.returncode != 0:
+                logger.warning("native channel build failed: %s",
+                               proc.stderr[-500:])
+                return False
+            os.replace(_SO + ".tmp", _SO)
+            return True
+    except Exception as e:
+        logger.warning("native channel build unavailable: %r", e)
+        return False
+
+
+def channel_lib() -> Optional[ctypes.CDLL]:
+    """The native channel library, or None (pure-Python fallback)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            stale = not os.path.exists(_SO) or \
+                os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        except OSError:
+            # .so shipped without the source: use it as-is
+            stale = not os.path.exists(_SO)
+        if stale and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.ch_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_uint64, ctypes.c_double]
+            lib.ch_write.restype = ctypes.c_int
+            lib.ch_wait_writable.argtypes = [ctypes.c_void_p, ctypes.c_double]
+            lib.ch_wait_writable.restype = ctypes.c_int
+            lib.ch_wait_readable.argtypes = [
+                ctypes.c_void_p, ctypes.c_double,
+                ctypes.POINTER(ctypes.c_uint64)]
+            lib.ch_wait_readable.restype = ctypes.c_int
+            lib.ch_read.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                    ctypes.c_uint64, ctypes.c_double,
+                                    ctypes.POINTER(ctypes.c_uint64)]
+            lib.ch_read.restype = ctypes.c_int
+            lib.ch_advance_tail.argtypes = [ctypes.c_void_p]
+            lib.ch_wake.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except OSError as e:
+            logger.warning("native channel load failed: %r", e)
+            _lib = None
+    return _lib

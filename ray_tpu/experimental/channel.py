@@ -11,29 +11,36 @@ no event-loop hop.  The reference's NCCL device channels
 tensors cross process boundaries via host shm here, and multi-chip device
 transfer rides the collective layer instead.
 
+Like the reference's channel runtime, the hot path is NATIVE where it
+matters: when ``ray_tpu._native`` builds (g++, first use), waits block on a
+shared futex and payload copies run with the GIL released
+(_native/channel.cpp).  Without it, a pure-Python spin/backoff path provides
+the same semantics — both sides interoperate through the same ring layout.
+
 Layout (little-endian u64s):
     [0]  head      — messages written (producer-owned)
     [8]  tail      — messages consumed (consumer-owned)
     [16] slot_size
     [24] depth
+    [32] futex word (u32; bumped on every publish) + 4B pad
     slots: depth x (u64 length + slot_size payload bytes)
 
 Aligned 8-byte stores are atomic and each counter has exactly one writer, so
 the ring needs no lock on x86-64, whose TSO memory model also guarantees the
 payload stores are visible before the head publish.  Weakly-ordered ISAs
 (ARM64) would need a release/acquire barrier Python cannot express — TPU
-hosts are x86-64, so that port is out of scope.  Waiting is hybrid: a short
-GIL-yield spin for the latency-critical case, then exponential sleep backoff.
+hosts are x86-64, so that port is out of scope.
 """
 
 from __future__ import annotations
 
+import ctypes
 import pickle
 import time
 from multiprocessing import resource_tracker, shared_memory
 from typing import Any, Optional
 
-_HDR = 32
+_HDR = 40
 _SLOT_HDR = 8
 
 # Sentinel lengths (no payload).
@@ -46,6 +53,20 @@ class ChannelClosed(Exception):
 
 class ChannelFull(Exception):
     pass
+
+
+def _native_wanted() -> bool:
+    """Native futex channels by default on multi-core hosts; measured on a
+    single shared core the calibrated sleep-backoff of the Python path
+    syncs the two processes faster than futex wake round-trips (434 vs
+    988 us ping-pong), so 1-core hosts stay on the fallback.  Override
+    with RAY_TPU_NATIVE_CHANNEL=1/0."""
+    import os
+
+    env = os.environ.get("RAY_TPU_NATIVE_CHANNEL")
+    if env is not None:
+        return env not in ("0", "false", "no")
+    return (os.cpu_count() or 1) > 1
 
 
 def _attach(name: str) -> shared_memory.SharedMemory:
@@ -82,6 +103,15 @@ class ShmChannel:
         self.slot_size = int.from_bytes(buf[16:24], "little")
         self.depth = int.from_bytes(buf[24:32], "little")
         self.name = self._shm.name
+        self._lib = None
+        self._cbuf = None
+        if _native_wanted():
+            from ray_tpu._native import channel_lib
+
+            self._lib = channel_lib()
+        if self._lib is not None:
+            self._cbuf = (ctypes.c_char * self._shm.size).from_buffer(
+                self._shm.buf)
 
     # ------------------------------------------------------------ counters
     def _head(self) -> int:
@@ -90,19 +120,30 @@ class ShmChannel:
     def _tail(self) -> int:
         return int.from_bytes(self._shm.buf[8:16], "little")
 
+    def _bump(self) -> None:
+        """Publish notification: bump the shared futex word (native waiters
+        re-check on every bump) and FUTEX_WAKE when the lib is loaded."""
+        buf = self._shm.buf
+        word = int.from_bytes(buf[32:36], "little")
+        buf[32:36] = ((word + 1) & 0xFFFFFFFF).to_bytes(4, "little")
+        if self._lib is not None:
+            self._lib.ch_wake(self._cbuf)
+
     def _set_head(self, v: int) -> None:
         self._shm.buf[0:8] = v.to_bytes(8, "little")
+        self._bump()
 
     def _set_tail(self, v: int) -> None:
         self._shm.buf[8:16] = v.to_bytes(8, "little")
+        self._bump()
 
     def _slot(self, i: int):
-        off = _HDR + (i % self.depth) * (_SLOT_HDR + self.slot_size)
-        return off
+        return _HDR + (i % self.depth) * (_SLOT_HDR + self.slot_size)
 
     @staticmethod
     def _wait(cond, timeout: Optional[float]):
-        """Hybrid wait: yield-spin briefly, then sleep with backoff."""
+        """Pure-Python hybrid wait: yield-spin briefly, then sleep with
+        backoff (used only when the native lib is unavailable)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         spin = 0
         delay = 20e-6
@@ -120,6 +161,12 @@ class ShmChannel:
     def wait_writable(self, timeout: Optional[float] = None) -> None:
         """Block until the ring has room.  With a single producer the room
         cannot disappear before the producer's own next write."""
+        if self._lib is not None:
+            rc = self._lib.ch_wait_writable(
+                self._cbuf, -1.0 if timeout is None else float(timeout))
+            if rc != 0:
+                raise TimeoutError("channel wait timed out")
+            return
         head = self._head()
         self._wait(lambda: head - self._tail() < self.depth, timeout)
 
@@ -129,6 +176,13 @@ class ShmChannel:
             raise ChannelFull(
                 f"message of {n} bytes exceeds channel slot size "
                 f"{self.slot_size}; recompile with a larger max_buf")
+        if self._lib is not None:
+            rc = self._lib.ch_write(
+                self._cbuf, payload, n,
+                -1.0 if timeout is None else float(timeout))
+            if rc != 0:  # -2 (oversize) is unreachable: checked above
+                raise TimeoutError("channel wait timed out")
+            return
         head = self._head()
         self._wait(lambda: head - self._tail() < self.depth, timeout)
         off = self._slot(head)
@@ -146,9 +200,8 @@ class ShmChannel:
         messages first); only a consumer gone for `timeout` loses the
         sentinel."""
         try:
+            self.wait_writable(timeout)
             head = self._head()
-            self._wait(lambda: head - self._tail() < self.depth,
-                       timeout=timeout)
             off = self._slot(head)
             self._shm.buf[off:off + _SLOT_HDR] = _LEN_CLOSE.to_bytes(8, "little")
             self._set_head(head + 1)
@@ -157,6 +210,22 @@ class ShmChannel:
 
     # --------------------------------------------------------------- read
     def read_bytes(self, timeout: Optional[float] = None) -> bytes:
+        if self._lib is not None:
+            n = ctypes.c_uint64()
+            rc = self._lib.ch_wait_readable(
+                self._cbuf, -1.0 if timeout is None else float(timeout),
+                ctypes.byref(n))
+            if rc != 0:
+                raise TimeoutError("channel wait timed out")
+            if n.value == _LEN_CLOSE:
+                self._lib.ch_advance_tail(self._cbuf)
+                raise ChannelClosed("producer closed the channel")
+            out = ctypes.create_string_buffer(n.value)
+            rc = self._lib.ch_read(self._cbuf, out, n.value, 0.0,
+                                   ctypes.byref(n))
+            if rc != 0:  # pragma: no cover - message was already readable
+                raise TimeoutError("channel read raced")
+            return out.raw[:n.value]
         tail = self._tail()
         self._wait(lambda: self._head() > tail, timeout)
         off = self._slot(tail)
@@ -174,6 +243,16 @@ class ShmChannel:
 
     # ----------------------------------------------------------- lifecycle
     def close(self) -> None:
+        # the native branch must die with the mapping: a later call passing
+        # a NULL base into C would segfault instead of raising
+        self._lib = None
+        if self._cbuf is not None:
+            # drop the exported ctypes view or shm.close() raises BufferError
+            try:
+                del self._cbuf
+            except Exception:
+                pass
+            self._cbuf = None
         try:
             self._shm.close()
         except BufferError:

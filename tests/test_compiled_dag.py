@@ -124,3 +124,40 @@ def test_compiled_beats_remote_chain_latency(cluster):
     assert speedup >= 5.0, (remote_dt, compiled_dt)
     for h in stages:
         ray_tpu.kill(h)
+
+
+def test_native_channel_interop(monkeypatch):
+    """The native futex channel (ray_tpu/_native/channel.cpp) and the
+    pure-Python path speak the same ring: native writer -> python reader
+    and vice versa, including the close sentinel."""
+    from ray_tpu import _native
+    from ray_tpu.experimental import channel as chmod
+
+    if _native.channel_lib() is None:
+        pytest.skip("native toolchain unavailable")
+
+    monkeypatch.setenv("RAY_TPU_NATIVE_CHANNEL", "1")
+    native = chmod.ShmChannel(create=True, slot_size=1 << 16, depth=2)
+    assert native._lib is not None
+    monkeypatch.setenv("RAY_TPU_NATIVE_CHANNEL", "0")
+    pyside = chmod.ShmChannel(native.name)
+    assert pyside._lib is None
+
+    # native -> python
+    native.write({"a": np.arange(3)})
+    out = pyside.read(timeout=10)
+    np.testing.assert_array_equal(out["a"], np.arange(3))
+    # python -> native (same ring, reversed roles)
+    pyside.write(b"pong")
+    assert native.read(timeout=10) == b"pong"
+    # backpressure across modes
+    native.write(1)
+    native.write(2)
+    assert pyside.read(timeout=10) == 1
+    assert pyside.read(timeout=10) == 2
+    # close sentinel from the native side
+    native.close_write()
+    with pytest.raises(ChannelClosed):
+        pyside.read(timeout=10)
+    pyside.close()
+    native.close()
