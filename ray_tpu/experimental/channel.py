@@ -65,7 +65,7 @@ def _native_wanted() -> bool:
 
     env = os.environ.get("RAY_TPU_NATIVE_CHANNEL")
     if env is not None:
-        return env not in ("0", "false", "no")
+        return env.strip().lower() not in ("0", "false", "no", "off", "")
     return (os.cpu_count() or 1) > 1
 
 
@@ -158,12 +158,22 @@ class ShmChannel:
             delay = min(delay * 2, 2e-3)
 
     # -------------------------------------------------------------- write
+    def _native_wait(self, fn, timeout: Optional[float], *args) -> int:
+        """Run a native wait, slicing indefinite waits into 0.5 s chunks so
+        Python-level signals (KeyboardInterrupt) still fire between calls —
+        C never returns to the interpreter mid-wait."""
+        if timeout is not None:
+            return fn(self._cbuf, float(timeout), *args)
+        while True:
+            rc = fn(self._cbuf, 0.5, *args)
+            if rc != -1:
+                return rc
+
     def wait_writable(self, timeout: Optional[float] = None) -> None:
         """Block until the ring has room.  With a single producer the room
         cannot disappear before the producer's own next write."""
         if self._lib is not None:
-            rc = self._lib.ch_wait_writable(
-                self._cbuf, -1.0 if timeout is None else float(timeout))
+            rc = self._native_wait(self._lib.ch_wait_writable, timeout)
             if rc != 0:
                 raise TimeoutError("channel wait timed out")
             return
@@ -177,9 +187,8 @@ class ShmChannel:
                 f"message of {n} bytes exceeds channel slot size "
                 f"{self.slot_size}; recompile with a larger max_buf")
         if self._lib is not None:
-            rc = self._lib.ch_write(
-                self._cbuf, payload, n,
-                -1.0 if timeout is None else float(timeout))
+            self.wait_writable(timeout)
+            rc = self._lib.ch_write(self._cbuf, payload, n, 0.5)
             if rc != 0:  # -2 (oversize) is unreachable: checked above
                 raise TimeoutError("channel wait timed out")
             return
@@ -212,9 +221,8 @@ class ShmChannel:
     def read_bytes(self, timeout: Optional[float] = None) -> bytes:
         if self._lib is not None:
             n = ctypes.c_uint64()
-            rc = self._lib.ch_wait_readable(
-                self._cbuf, -1.0 if timeout is None else float(timeout),
-                ctypes.byref(n))
+            rc = self._native_wait(self._lib.ch_wait_readable, timeout,
+                                   ctypes.byref(n))
             if rc != 0:
                 raise TimeoutError("channel wait timed out")
             if n.value == _LEN_CLOSE:

@@ -67,6 +67,24 @@ class _WorkflowStorage:
         with open(self._step_path(step_id), "rb") as f:
             return pickle.load(f)
 
+    def load_step_or_discard(self, step_id: str):
+        """(True, value) for a readable step; (False, None) after discarding
+        a half-written/corrupt file (a crash between open and the atomic
+        rename can't produce one, but a torn disk or manual copy can — the
+        recovery contract is re-run, never trust garbage).  ONLY corruption
+        signatures discard: transient IO errors (EMFILE/EIO) propagate
+        rather than destroying durable state and re-running side-effecting
+        steps."""
+        try:
+            return True, self.load_step(step_id)
+        except (EOFError, pickle.UnpicklingError, ValueError, KeyError,
+                IndexError):
+            try:
+                os.remove(self._step_path(step_id))
+            except OSError:
+                pass
+            return False, None
+
     def save_step(self, step_id: str, value: Any) -> None:
         tmp = self._step_path(step_id) + ".tmp"
         with open(tmp, "wb") as f:
@@ -162,9 +180,10 @@ def _execute(dag: DAGNode, store: _WorkflowStorage) -> Any:
         if key in cache:
             return cache[key]
         step_id = ids[key]
+        loaded = False
         if store.has_step(step_id):
-            value = store.load_step(step_id)
-        else:
+            loaded, value = store.load_step_or_discard(step_id)
+        if not loaded:
             args = [run_node(a) if isinstance(a, DAGNode) else a
                     for a in node._bound_args]
             kwargs = {k: (run_node(v) if isinstance(v, DAGNode) else v)

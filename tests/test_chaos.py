@@ -1,0 +1,171 @@
+"""Negative-path / chaos interleavings (reference test strategy:
+python/ray/tests/test_gcs_fault_tolerance.py, test_component_failures*.py —
+the suites that kill components at the worst moment and assert recovery)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster():
+    from conftest import ensure_shared_runtime
+
+    yield ensure_shared_runtime()
+
+
+def test_workflow_resume_with_half_written_step(cluster, tmp_path):
+    """A torn step file (crash mid-write / disk corruption) must be
+    re-computed on resume, not trusted or fatal."""
+    from ray_tpu import workflow
+    from ray_tpu.workflow import _WorkflowStorage
+
+    calls_file = str(tmp_path / "calls.txt")
+
+    @ray_tpu.remote
+    def add_one(x):
+        with open(calls_file, "a") as f:
+            f.write("x")
+        return x + 1
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    dag = double.bind(add_one.bind(20))
+    storage = str(tmp_path / "wf")
+    out = workflow.run(dag, workflow_id="torn", storage=storage)
+    assert out == 42
+    assert len(open(calls_file).read()) == 1
+
+    # corrupt the add_one step file: truncated pickle + a stray tmp
+    store = _WorkflowStorage(storage, "torn")
+    steps_dir = os.path.join(store.dir, "steps")
+    victims = [f for f in os.listdir(steps_dir) if f.endswith(".pkl")]
+    assert victims
+    for f in victims:
+        path = os.path.join(steps_dir, f)
+        with open(path, "wb") as fh:
+            fh.write(b"\x80\x04half-written garbage")
+        with open(path + ".tmp", "wb") as fh:
+            fh.write(b"partial")
+
+    assert workflow.resume("torn", storage=storage) == 42
+    # the corrupt steps were re-executed, not trusted
+    assert len(open(calls_file).read()) == 2
+
+
+def test_serve_replica_dies_mid_request(cluster):
+    """A replica that dies WHILE executing: the in-flight request fails
+    loudly, and the controller replaces the replica so the service heals
+    (reference: serve replica recovery reconciliation)."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=1)
+    class Fragile:
+        def __call__(self, body):
+            if body == "poison":
+                os._exit(1)  # hard kill mid-request
+            return f"ok:{body}"
+
+    serve.run(Fragile.bind(), name="fragile", route_prefix="/fragile")
+    h = serve.get_app_handle("fragile")
+    assert h.remote("a").result(60) == "ok:a"
+
+    with pytest.raises(Exception):
+        h.remote("poison").result(60)
+
+    # service heals: a replacement replica serves again
+    deadline = time.monotonic() + 120
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            if h.remote("b").result(10) == "ok:b":
+                break
+        except Exception as e:
+            last = e
+            time.sleep(0.5)
+    else:
+        raise AssertionError(f"service never healed: {last!r}")
+    serve.delete("fragile")
+
+
+def test_gcs_restart_while_pg_pending(tmp_path):
+    """GCS dies holding a PENDING placement group (mid-2PC: bundles not yet
+    placeable); after restart + capacity arriving, the gang completes
+    (reference: GCS FT replaying GcsInitData + PG rescheduling)."""
+    from ray_tpu._private.config import RayConfig
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.placement_group import (placement_group,
+                                              remove_placement_group)
+
+    ray_tpu.shutdown()
+    RayConfig.set("gcs_storage_path", str(tmp_path / "gcs.db"))
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=1)
+        ray_tpu.init(address=cluster.address)
+        cluster.wait_for_nodes()
+
+        # STRICT_SPREAD 2x{CPU:1} on a 1-node cluster: stays PENDING
+        pg = placement_group([{"CPU": 1}, {"CPU": 1}],
+                             strategy="STRICT_SPREAD")
+        assert not pg.ready(timeout=3)
+
+        cluster.head_node.kill_gcs()
+        time.sleep(1.0)
+        cluster.head_node.restart_gcs()
+
+        # capacity arrives AFTER the restart; the restored pending PG must
+        # still schedule
+        cluster.add_node(num_cpus=1)
+        assert pg.ready(timeout=120), \
+            "pending PG lost across GCS restart"
+        remove_placement_group(pg)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        RayConfig.set("gcs_storage_path", "")
+
+
+def test_tune_concurrent_trial_failures(cluster, tmp_path):
+    """Concurrent trials where some fail (twice, then succeed) while others
+    report under ASHA: the experiment completes with every trial resolved
+    (reference: Tune FailureConfig + scheduler interplay under failures)."""
+    from ray_tpu import tune
+    from ray_tpu.air.config import FailureConfig, RunConfig
+
+    fail_dir = str(tmp_path / "flaky")
+    os.makedirs(fail_dir, exist_ok=True)
+
+    def trainable(config):
+        from ray_tpu import tune as t
+
+        marker = os.path.join(fail_dir, f"t{config['i']}")
+        for step in range(4):
+            if config["i"] % 2 == 0 and step == 2 and \
+                    not os.path.exists(marker):
+                open(marker, "w").write("failed-once")
+                raise RuntimeError("injected mid-training failure")
+            t.report({"score": config["i"] * 10 + step})
+        return {"score": config["i"] * 10 + 3}
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"i": tune.grid_search([0, 1, 2, 3])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=3,
+            scheduler=tune.ASHAScheduler(max_t=4, grace_period=1)),
+        run_config=RunConfig(name="chaos", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=2)),
+    )
+    grid = tuner.fit()
+    results = list(grid)
+    assert len(results) == 4
+    # the even trials failed once each, then retried to completion
+    assert sorted(os.listdir(fail_dir)) == ["t0", "t2"]
+    best = grid.get_best_result()
+    assert best.metrics["score"] >= 30
