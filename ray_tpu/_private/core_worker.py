@@ -41,7 +41,8 @@ import cloudpickle
 
 from ray_tpu._private import rpc
 from ray_tpu._private.config import RayConfig
-from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID, WorkerID
+from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
+                                  WorkerID, _fast_unique)
 from ray_tpu._private.memory_store import IN_PLASMA, MemoryStore
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import PlasmaClient
@@ -82,6 +83,15 @@ class _TaskContext(threading.local):
         self.job_id: Optional[JobID] = None
         self.attempt_number: int = 0
         self.task_name: str = ""
+
+
+# Tracing context: a ContextVar, NOT thread-local — async actor methods all
+# share the IO-loop thread, and each asyncio task carries its own context
+# copy, so spans stay correct across interleaved coroutines.
+import contextvars  # noqa: E402
+
+_trace_ctx: "contextvars.ContextVar" = contextvars.ContextVar(
+    "ray_tpu_trace", default=(None, None))
 
 
 class CoreWorker:
@@ -251,6 +261,9 @@ class CoreWorker:
             return
         aid = spec.actor_id or spec.actor_creation_id
         ev = {
+            "trace_id": spec.trace_id,
+            "span_id": spec.span_id,
+            "parent_span_id": spec.parent_span_id,
             "task_id": spec.task_id.hex(),
             "attempt": spec.attempt_number,
             "name": spec.name,
@@ -879,6 +892,17 @@ class CoreWorker:
         os._exit(0)
 
     # ========================================================= task submission
+    def _child_trace(self) -> tuple:
+        """(trace_id, span_id, parent_span_id) for a task submitted from
+        this context: inherits the executing task's trace (the span context
+        travels INSIDE the spec, reference tracing_helper.py:36-60); a
+        driver-side submission with no active span starts a new trace."""
+        span_id = _fast_unique(8).hex()
+        trace_id, parent = _trace_ctx.get()
+        if trace_id is not None:
+            return trace_id, span_id, parent
+        return _fast_unique(16).hex(), span_id, None
+
     def _function_payload(self, fn) -> Tuple[Optional[bytes], Optional[str]]:
         # Cache per function object: re-cloudpickling an unchanged function on
         # every `.remote()` cost ~0.4ms/call and dominated the submit path.
@@ -935,6 +959,7 @@ class CoreWorker:
         blob, key = self._function_payload(fn)
         spec_args, kw_keys, holds = self._build_args(args, kwargs)
         task_id = TaskID.for_task(self.job_id)
+        trace_id, span_id, parent_span = self._child_trace()
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id, task_type=TaskType.NORMAL_TASK,
             name=name, function_blob=blob, function_key=key, args=spec_args,
@@ -943,6 +968,7 @@ class CoreWorker:
             retry_exceptions=retry_exceptions,
             owner_worker_id=self.worker_id.binary(), owner_addr=self.addr,
             runtime_env=runtime_env,
+            trace_id=trace_id, span_id=span_id, parent_span_id=parent_span,
         )
         refs = []
         for oid in spec.return_ids():
@@ -963,6 +989,7 @@ class CoreWorker:
         spec_args, kw_keys, holds = self._build_args(args, kwargs)
         actor_id = ActorID.of(self.job_id)
         task_id = TaskID.for_actor_creation(actor_id)
+        trace_id, span_id, parent_span = self._child_trace()
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id, task_type=TaskType.ACTOR_CREATION_TASK,
             name=getattr(cls, "__name__", "Actor"), function_blob=blob, function_key=key,
@@ -972,6 +999,7 @@ class CoreWorker:
             max_task_retries=max_task_retries, max_concurrency=max_concurrency,
             actor_name=name, namespace=namespace if namespace is not None else self.namespace,
             runtime_env=runtime_env,
+            trace_id=trace_id, span_id=span_id, parent_span_id=parent_span,
         )
         self.io.run(self.gcs_conn.call("create_actor", {
             "spec": pickle.dumps(spec), "detached": detached,
@@ -993,6 +1021,7 @@ class CoreWorker:
                           max_task_retries: int = 0) -> List[ObjectRef]:
         spec_args, kw_keys, holds = self._build_args(args, kwargs)
         task_id = TaskID.for_actor_task(actor_id)
+        trace_id, span_id, parent_span = self._child_trace()
         spec = TaskSpec(
             task_id=task_id, job_id=self.job_id, task_type=TaskType.ACTOR_TASK,
             name=method_name, function_blob=None, function_key=None, args=spec_args,
@@ -1000,6 +1029,7 @@ class CoreWorker:
             owner_worker_id=self.worker_id.binary(), owner_addr=self.addr,
             actor_id=actor_id, actor_method_name=method_name,
             max_task_retries=max_task_retries,
+            trace_id=trace_id, span_id=span_id, parent_span_id=parent_span,
         )
         refs = []
         for oid in spec.return_ids():
@@ -1614,11 +1644,13 @@ class CoreWorker:
         self.task_ctx.task_id = spec.task_id
         self.task_ctx.job_id = spec.job_id
         self.task_ctx.actor_id = spec.actor_creation_id
+        trace_token = _trace_ctx.set((spec.trace_id, spec.span_id))
         try:
             self.actor_instance = cls(*args, **kwargs)
         except BaseException as e:
             return {"status": "error",
                     "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
+        _trace_ctx.reset(trace_token)
         self.actor_id = spec.actor_creation_id
         self.job_id = spec.job_id
         if spec.max_concurrency > 1 or _has_async_methods(type(self.actor_instance)):
@@ -1638,6 +1670,7 @@ class CoreWorker:
         self.task_ctx.job_id = spec.job_id
         self.task_ctx.task_name = spec.name
         self.task_ctx.attempt_number = spec.attempt_number
+        trace_token = _trace_ctx.set((spec.trace_id, spec.span_id))
         if self.job_id.int_value() == 0:
             self.job_id = spec.job_id
         try:
@@ -1652,8 +1685,10 @@ class CoreWorker:
                     "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
         finally:
             self.task_ctx.task_id = None
+            _trace_ctx.reset(trace_token)
 
     async def _invoke_async(self, spec: TaskSpec, method) -> dict:
+        trace_token = _trace_ctx.set((spec.trace_id, spec.span_id))
         try:
             loop = asyncio.get_event_loop()
             args, kwargs = await loop.run_in_executor(None, self._resolve_args, spec)
@@ -1664,6 +1699,8 @@ class CoreWorker:
         except BaseException as e:
             return {"status": "error",
                     "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
+        finally:
+            _trace_ctx.reset(trace_token)
 
     def _pack_returns(self, spec: TaskSpec, out) -> dict:
         if spec.num_returns == 0:
@@ -2120,6 +2157,7 @@ class NormalTaskSubmitter:
                 retriable = True
             if retriable:
                 spec.attempt_number += 1
+                spec.span_id = _fast_unique(8).hex()  # span per attempt
                 self.cw.emit_task_event(spec, "SUBMITTED")
                 st["pending"].append((spec, holds))
             else:
@@ -2130,6 +2168,7 @@ class NormalTaskSubmitter:
             worker_ok = False
             if spec.attempt_number < spec.max_retries:
                 spec.attempt_number += 1
+                spec.span_id = _fast_unique(8).hex()  # span per attempt
                 logger.info("retrying task %s (attempt %d) after worker failure",
                             spec.name, spec.attempt_number)
                 self.cw.emit_task_event(spec, "SUBMITTED")
@@ -2269,6 +2308,7 @@ class ActorTaskSubmitter:
             if spec.max_task_retries != 0 and \
                     spec.attempt_number < max(spec.max_task_retries, 0):
                 spec.attempt_number += 1
+                spec.span_id = _fast_unique(8).hex()  # span per attempt
                 with self._queue_lock:
                     self._queue.append((spec, holds))
                 retried = True

@@ -97,6 +97,13 @@ class TaskSpec:
     runtime_env: Optional[dict] = None
     # Attempt number (0 = first attempt); bumped on retry.
     attempt_number: int = 0
+    # Tracing: span context propagated WITH the spec, the reference's
+    # OpenTelemetry pattern (reference: util/tracing/tracing_helper.py:36-60
+    # injects the active span context into the task's serialized metadata).
+    # hex ids; parent_span_id is the submitting task's span.
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
+    parent_span_id: Optional[str] = None
 
     def return_ids(self) -> List[ObjectID]:
         return [ObjectID.from_task(self.task_id, i) for i in range(self.num_returns)]

@@ -35,6 +35,18 @@ class RuntimeContext:
     def namespace(self) -> str:
         return self._core.namespace
 
+    def get_trace_id(self) -> Optional[str]:
+        """The current task's trace id (spans propagate through task specs;
+        reference: util/tracing/tracing_helper.py)."""
+        from ray_tpu._private.core_worker import _trace_ctx
+
+        return _trace_ctx.get()[0]
+
+    def get_span_id(self) -> Optional[str]:
+        from ray_tpu._private.core_worker import _trace_ctx
+
+        return _trace_ctx.get()[1]
+
     def get_job_id(self) -> str:
         return self.job_id.hex() if self.job_id else ""
 

@@ -91,6 +91,9 @@ def list_tasks(limit: int = 1000, job_id: Optional[str] = None,
             "type": ev.get("type"),
             "job_id": ev.get("job_id"),
             "actor_id": ev.get("actor_id"),
+            "trace_id": ev.get("trace_id"),
+            "span_id": ev.get("span_id"),
+            "parent_span_id": ev.get("parent_span_id"),
             "state_ts": {},
         })
         row["state_ts"][ev["state"]] = ev["ts"]
@@ -141,3 +144,34 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
         with open(filename, "w") as f:
             json.dump(trace, f)
     return trace
+
+
+def get_trace(trace_id: str) -> List[Dict[str, Any]]:
+    """Spans of one trace, parent-linked and time-ordered — the span context
+    travels inside task specs, so every task/actor call submitted (however
+    transitively) under one root shares its trace_id (reference:
+    util/tracing/tracing_helper.py span propagation; here spans ride the
+    task-event pipeline instead of an external OTLP collector).
+
+    Each span: task_id/name/span_id/parent_span_id plus start/end drawn
+    from the RUNNING/FINISHED (or FAILED) timestamps.
+    """
+    spans = []
+    for row in list_tasks(limit=100_000):
+        if row.get("trace_id") != trace_id:
+            continue
+        ts = row.get("state_ts", {})
+        spans.append({
+            "span_id": row.get("span_id"),
+            "parent_span_id": row.get("parent_span_id"),
+            "trace_id": trace_id,
+            "name": row.get("name"),
+            "task_id": row["task_id"],
+            "state": row.get("state"),
+            "start": ts.get("RUNNING", ts.get("SUBMITTED")),
+            "end": ts.get("FINISHED", ts.get("FAILED")),
+            "node_id": row.get("node_id"),
+            "worker_id": row.get("worker_id"),
+        })
+    spans.sort(key=lambda s: (s["start"] is None, s["start"]))
+    return spans

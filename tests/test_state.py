@@ -125,3 +125,62 @@ def test_entity_listings(ray_start_regular):
 
     jobs = state.list_jobs()
     assert jobs
+
+
+def test_trace_spans_propagate_through_nesting(ray_start_regular):
+    """Span context travels inside task specs (reference:
+    util/tracing/tracing_helper.py:36-60): nested tasks and actor calls
+    share the root's trace_id and parent onto the submitting span."""
+    import time as _time
+
+    import ray_tpu
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class Leaf:
+        def work(self, x):
+            return x + 1
+
+    leaf = Leaf.remote()
+
+    @ray_tpu.remote
+    def inner():
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.get_trace_id(), ctx.get_span_id()
+
+    @ray_tpu.remote
+    def outer():
+        ctx = ray_tpu.get_runtime_context()
+        nested = ray_tpu.get(inner.remote())
+        actor_val = ray_tpu.get(leaf.work.remote(1))
+        return ctx.get_trace_id(), ctx.get_span_id(), nested, actor_val
+
+    trace_id, root_span, (inner_trace, inner_span), actor_val = \
+        ray_tpu.get(outer.remote(), timeout=60)
+    assert trace_id and root_span
+    assert inner_trace == trace_id          # one trace end to end
+    assert inner_span != root_span
+
+    # events flush async; poll the state API for the full trace
+    def short(name):
+        return (name or "").split(".")[-1]
+
+    deadline = _time.monotonic() + 60
+    spans = []
+    while _time.monotonic() < deadline:
+        spans = state.get_trace(trace_id)
+        names = {short(s["name"]) for s in spans}
+        if {"outer", "inner", "work"} <= names and all(
+                s["end"] is not None for s in spans
+                if short(s["name"]) in ("outer", "inner", "work")):
+            break
+        _time.sleep(0.5)
+    by_name = {short(s["name"]): s for s in spans}
+    assert {"outer", "inner", "work"} <= set(by_name), spans
+    assert by_name["inner"]["parent_span_id"] == by_name["outer"]["span_id"]
+    assert by_name["work"]["parent_span_id"] == by_name["outer"]["span_id"]
+    assert by_name["outer"]["parent_span_id"] is None
+    # a separate driver submission starts a NEW trace
+    t2, _s, _n, _a = ray_tpu.get(outer.remote(), timeout=60)
+    assert t2 != trace_id
+    ray_tpu.kill(leaf)
