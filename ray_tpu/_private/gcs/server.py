@@ -45,7 +45,7 @@ class NodeInfo:
     __slots__ = ("node_id", "addr", "resources_total", "resources_available",
                  "labels", "conn", "alive", "last_seen", "start_time", "node_name",
                  "object_store_capacity", "death_cause", "pending_demand",
-                 "metrics_addr", "busy_workers")
+                 "metrics_addr", "busy_workers", "view_version")
 
     def __init__(self, node_id: NodeID, addr: Tuple[str, int], resources_total: Dict[str, float],
                  labels: Dict[str, str], conn: rpc.Connection, node_name: str = ""):
@@ -64,6 +64,7 @@ class NodeInfo:
         self.object_store_capacity = 0
         self.death_cause = ""
         self.busy_workers = 0  # leased workers + live actors (idle detection)
+        self.view_version = -1  # versioned sync (reference: ray_syncer.proto)
 
     def view(self) -> dict:
         return {
@@ -76,6 +77,9 @@ class NodeInfo:
             "node_name": self.node_name,
             "start_time": self.start_time,
             "metrics_addr": self.metrics_addr,
+            # versioned-sync seed: subscribers apply later deltas only when
+            # newer than this snapshot
+            "view_version": self.view_version,
         }
 
 
@@ -183,6 +187,7 @@ class GcsServer:
 
         self.store = make_store(RayConfig.gcs_storage_path or None)
         self._restored_unconfirmed: Set[ActorID] = set()
+        self.resource_broadcasts = 0  # versioned-sync effectiveness counter
         self._load_from_store()
 
     # ------------------------------------------------------------ persistence
@@ -365,6 +370,12 @@ class GcsServer:
         info.metrics_addr = tuple(ma) if ma and ma[1] else None
         self.nodes[node_id] = info
         conn.context["node_id"] = node_id.binary()
+        # Subscribe the node's channels ATOMICALLY with the snapshot it gets
+        # in this reply: a delta published between the reply and a separate
+        # subscribe RPC would otherwise be lost — and with versioned sync
+        # suppressing unchanged rebroadcasts, never repaired.
+        self.subscribers.setdefault("resource_view", set()).add(conn)
+        self.subscribers.setdefault("node", set()).add(conn)
         # Re-registration after a GCS restart (or a dropped connection): the
         # node re-reports its live actors, PG bundles, and local objects so
         # restored state reconciles with reality (reference: raylets
@@ -402,12 +413,21 @@ class GcsServer:
         info.busy_workers = msg.get("busy_workers", 0)
         if msg.get("total"):
             info.resources_total = msg["total"]
-        # Broadcast the delta so every nodelet's cluster view converges
-        # (reference: ray_syncer resource-view stream).
+        # Versioned sync (reference: ray_syncer.proto:62 snapshot versions):
+        # an UNCHANGED view (same version as last broadcast) is liveness
+        # only — rebroadcasting it would make steady-state traffic
+        # O(nodes^2) for no information.
+        version = msg.get("version")
+        if version is not None and version == info.view_version:
+            return {"dead": False}
+        if version is not None:
+            info.view_version = version
+        self.resource_broadcasts += 1
         await self.publish("resource_view", {
             "node_id": msg["node_id"],
             "available": msg["available"],
             "total": info.resources_total,
+            "version": version,
         })
         return {"dead": False}
 
@@ -469,6 +489,7 @@ class GcsServer:
             # GCS restart may restore stale state.  Surfaced here so `status`
             # CLI / dashboards can warn before the restart happens.
             "gcs_storage_degraded": getattr(self.store, "degraded", False),
+            "resource_broadcasts": self.resource_broadcasts,
         }
 
     async def rpc_get_cluster_view(self, conn, msg):

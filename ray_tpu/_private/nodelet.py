@@ -266,14 +266,22 @@ class Nodelet:
     async def _on_publish(self, conn, msg):
         channel, data = msg["channel"], msg["data"]
         if channel == "resource_view":
+            version = data.get("version")
             view = self.cluster_view.get(data["node_id"])
             if view is not None:
+                last = view.get("view_version")
+                if version is not None and last is not None and \
+                        version <= last:
+                    return  # stale/reordered delta: versions apply monotonically
                 view["available"] = data["available"]
                 view["total"] = data["total"]
+                if version is not None:
+                    view["view_version"] = version
             else:
                 self.cluster_view[data["node_id"]] = {
                     "node_id": data["node_id"], "available": data["available"],
                     "total": data["total"], "addr": None, "alive": True,
+                    "view_version": version,
                 }
             self._pump_queued_leases()
         elif channel == "node":
@@ -286,6 +294,12 @@ class Nodelet:
     # ---------------------------------------------------------- gcs reports
     async def _report_loop(self):
         interval = RayConfig.heartbeat_interval_ms / 1000.0
+        # Versioned resource view (reference: ray_syncer.proto:62 versioned
+        # snapshots): the version bumps ONLY when the view changes, so the
+        # GCS can skip rebroadcasting unchanged reports — steady-state sync
+        # traffic drops to liveness pings instead of O(nodes^2) view spam.
+        view_version = 0
+        last_fingerprint = None
         while True:
             await asyncio.sleep(interval)
             try:
@@ -308,12 +322,22 @@ class Nodelet:
                 busy = sum(1 for w in self.workers.values()
                            if w.state == "leased"
                            or (w.is_actor and w.state != "dead"))
+                # fingerprint covers ONLY the broadcast payload
+                # (available/total): demand and busy-count ride every report
+                # regardless, and versioning them would rebroadcast identical
+                # views on queue churn
+                fingerprint = (tuple(sorted(self.resources_available.items())),
+                               tuple(sorted(self.resources_total.items())))
+                if fingerprint != last_fingerprint:
+                    view_version += 1
+                    last_fingerprint = fingerprint
                 resp = await self.gcs.call("resource_report", {
                     "node_id": self.node_id.binary(),
                     "available": self.resources_available,
                     "total": self.resources_total,
                     "pending_demand": demand,
                     "busy_workers": busy,
+                    "version": view_version,
                 }, timeout=RayConfig.gcs_rpc_timeout_s)
                 if resp.get("dead"):
                     logger.error("GCS declared this node dead; exiting")

@@ -117,3 +117,44 @@ def test_failed_actor_constructor_kills_worker():
     assert not _procs_matching(session_dir), (
         f"leaked worker after ctor failure: {_procs_matching(session_dir)}")
     ray_tpu.shutdown()
+
+
+def test_versioned_resource_sync_quiesces(ray_start_cluster):
+    """Versioned view sync (reference: ray_syncer.proto versioned snapshots):
+    an idle cluster stops rebroadcasting resource views — heartbeats keep
+    flowing, broadcasts only happen when a view actually changes."""
+    import time
+
+    import ray_tpu
+    from ray_tpu._private.config import RayConfig
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+    cluster.add_node(num_cpus=2)
+    cluster.wait_for_nodes()
+    core = ray_tpu._private.worker.require_core()
+
+    def status():
+        return core.io.run(core.gcs_conn.call("get_cluster_status", {}))
+
+    # let startup churn settle (worker pools, first reports)
+    hb = RayConfig.heartbeat_interval_ms / 1000.0
+    time.sleep(8 * hb)
+    b0 = status()["resource_broadcasts"]
+    time.sleep(6 * hb)
+    b1 = status()["resource_broadcasts"]
+    assert b1 - b0 <= 2, (
+        f"idle cluster kept rebroadcasting views: {b0} -> {b1}")
+
+    # real work changes the view -> broadcasts resume and converge
+    @ray_tpu.remote(num_cpus=2)
+    def burn():
+        time.sleep(4 * 0.2)
+        return 1
+
+    ref = burn.remote()
+    time.sleep(3 * hb)
+    b2 = status()["resource_broadcasts"]
+    assert b2 > b1, "resource change did not rebroadcast"
+    assert ray_tpu.get(ref, timeout=60) == 1
