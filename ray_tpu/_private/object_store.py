@@ -573,25 +573,53 @@ class RemotePlasmaClient:
         self._conn = conn
 
     def put(self, oid: ObjectID, flat) -> None:
-        self._put_bytes(oid, bytes(flat))
+        self._put_bytes(oid, flat)
 
     def put_serialized(self, oid: ObjectID, ser) -> None:
         buf = bytearray(ser.total_frame_bytes())
         ser.write_into(memoryview(buf))
-        self._put_bytes(oid, bytes(buf))
+        self._put_bytes(oid, memoryview(buf))
 
-    def _put_bytes(self, oid: ObjectID, data: bytes) -> None:
-        # same transient store-full patience as the local client's _create
+    def _put_bytes(self, oid: ObjectID, data) -> None:
+        """Small puts ride one frame; large ones stream in chunks so a
+        multi-GiB ray.put from a ray:// driver never balloons either end's
+        memory with a monolithic message (gets were already chunked)."""
+        data = data if isinstance(data, memoryview) else memoryview(data)
+        chunk = RayConfig.fetch_chunk_bytes
         deadline = time.monotonic() + 30.0
         while True:
             try:
-                self._conn.call_sync("plasma_put_bytes",
-                                     {"oid": oid.binary(), "data": data})
-                return
+                if data.nbytes <= chunk:
+                    self._conn.call_sync("plasma_put_bytes",
+                                         {"oid": oid.binary(),
+                                          "data": bytes(data)})
+                    return
+                resp = self._conn.call_sync("plasma_put_begin",
+                                            {"oid": oid.binary(),
+                                             "size": data.nbytes})
+                if resp.get("exists"):
+                    return
+                break
             except ObjectStoreFullError:
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(RayConfig.object_store_full_delay_ms / 1000.0)
+        try:
+            off = 0
+            while off < data.nbytes:
+                part = data[off:off + chunk]
+                self._conn.call_sync("plasma_put_chunk",
+                                     {"oid": oid.binary(), "offset": off,
+                                      "data": bytes(part)})
+                off += part.nbytes
+            self._conn.call_sync("plasma_seal", {"oid": oid.binary()})
+        except BaseException:
+            try:
+                self._conn.call_sync("plasma_put_abort",
+                                     {"oid": oid.binary()})
+            except Exception:
+                pass
+            raise
 
     def get_mapped(self, oid: ObjectID, timeout=None):
         """Wait server-side (plasma_get pins), then stream chunks over RPC."""
@@ -708,6 +736,31 @@ def register_store_handlers(handlers: dict, store: PlasmaStore, waiters: dict,
                 fut.set_result(True)
         return True
 
+    async def plasma_put_begin(conn, msg):
+        """Chunked client-mode put: allocate the landing entry (reference:
+        chunked object transfer, object_manager.proto — a multi-GiB put must
+        not ride one RPC frame on either end)."""
+        oid = ObjectID(msg["oid"])
+        if store.contains(oid):
+            return {"exists": True}
+        store.create(oid, msg["size"])
+        # tracked like plasma_create: a driver dying mid-put must not leak
+        # the unsealed entry (cleanup_client_connection sweeps this set)
+        conn.context.setdefault("plasma_creating", set()).add(oid)
+        return {"exists": False}
+
+    async def plasma_put_chunk(conn, msg):
+        oid = ObjectID(msg["oid"])
+        off = msg["offset"]
+        data = msg["data"]
+        store.write_buffer(oid)[off:off + len(data)] = data
+
+    async def plasma_put_abort(conn, msg):
+        oid = ObjectID(msg["oid"])
+        store.abort(oid)
+        conn.context.get("plasma_creating", set()).discard(oid)
+        return True
+
     async def plasma_contains(conn, msg):
         return store.contains(ObjectID(msg["oid"]))
 
@@ -731,6 +784,9 @@ def register_store_handlers(handlers: dict, store: PlasmaStore, waiters: dict,
 
     handlers.update(
         plasma_put_bytes=plasma_put_bytes,
+        plasma_put_begin=plasma_put_begin,
+        plasma_put_chunk=plasma_put_chunk,
+        plasma_put_abort=plasma_put_abort,
         plasma_create=plasma_create,
         plasma_seal=plasma_seal,
         plasma_get=plasma_get,
