@@ -140,3 +140,14 @@ _d("log_to_driver", bool, True, "forward worker stdout/stderr to the driver")
 # --- Collectives ---
 _d("collective_rendezvous_timeout_s", float, 60.0, "collective group formation timeout")
 _d("collective_op_timeout_s", float, 300.0, "single collective op timeout")
+
+# --- Runtime environments ---
+_d("runtime_env_pip_no_index", bool, False,
+   "pass --no-index to pip installs (hermetic/offline clusters)")
+_d("runtime_env_pip_find_links", str, "",
+   "extra --find-links wheel directory for pip runtime envs")
+_d("runtime_env_setup_timeout_s", float, 600.0,
+   "creating one pip/container env must finish within this")
+_d("runtime_env_container_runtime", str, "",
+   "container binary for image_uri envs ('docker'/'podman'; "
+   "'fake' = in-process test double; auto-detect when empty)")

@@ -67,6 +67,7 @@ from ray_tpu.exceptions import (
     RayActorError,
     RaySystemError,
     RayTaskError,
+    RuntimeEnvSetupError,
     TaskCancelledError,
     WorkerCrashedError,
 )
@@ -454,6 +455,45 @@ class CoreWorker:
         finally:
             if not handed_off:
                 self._gcs_reconnecting = False
+
+    async def gcs_call(self, method: str, obj=None, timeout=None):
+        """A GCS call that survives a GCS restart.
+
+        Blocking user-facing calls (``pg.ready()``, state queries, kv reads)
+        must not surface ``ConnectionLost`` while ``_gcs_reconnect_loop`` is
+        swapping in a fresh connection — the reference's GcsClient retries
+        transparently under GCS FT (reference:
+        src/ray/gcs/gcs_client/gcs_client.cc retry-on-unavailable).  Only
+        idempotent methods may be routed here: a request that died in flight
+        is re-issued verbatim against the restarted server.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            conn = self.gcs_conn
+            try:
+                # each attempt gets the REMAINING budget, not a fresh one
+                attempt_timeout = None if deadline is None else \
+                    max(deadline - time.monotonic(), 0.001)
+                return await conn.call(method, obj, attempt_timeout)
+            except (rpc.ConnectionLost, ConnectionError):
+                if self._shut:
+                    raise
+                if conn.closed:
+                    # Guarded against double-start; covers a drop in the
+                    # window where the close callback never fired.
+                    self._on_gcs_lost(conn)
+                # Bounded wait for the reconnect loop to install a live conn.
+                wait_until = time.monotonic() + RayConfig.gcs_reconnect_timeout_s
+                while self.gcs_conn is conn or self.gcs_conn.closed:
+                    now = time.monotonic()
+                    if self._shut or now > wait_until or \
+                            (deadline is not None and now > deadline):
+                        raise
+                    await asyncio.sleep(0.05)
+
+    def gcs_call_sync(self, method: str, obj=None, timeout=None):
+        """Blocking helper around :meth:`gcs_call` for API-surface modules."""
+        return self.io.run(self.gcs_call(method, obj, timeout))
 
     # ======================================================== object: put/get
     def _next_put_id(self) -> ObjectID:
@@ -1650,7 +1690,10 @@ class CoreWorker:
         except BaseException as e:
             return {"status": "error",
                     "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
-        _trace_ctx.reset(trace_token)
+        finally:
+            # always restore: a failed constructor must not leave the
+            # creation span as this executor thread's ambient context
+            _trace_ctx.reset(trace_token)
         self.actor_id = spec.actor_creation_id
         self.job_id = spec.job_id
         if spec.max_concurrency > 1 or _has_async_methods(type(self.actor_instance)):
@@ -2030,9 +2073,14 @@ class NormalTaskSubmitter:
                 bundle = (s.placement_group_id.binary(),
                           s.placement_group_bundle_index)
             conn = await self._lease_target(spec)
+            from ray_tpu import runtime_env as renv_mod
+
+            ekey = renv_mod.env_key(spec.runtime_env)
             msg = {"resources": spec.resources,
                    "strategy": {"kind": s.kind, "node_id": s.node_id, "soft": s.soft},
-                   "bundle": bundle, "spillback_count": 0, "token": token}
+                   "bundle": bundle, "spillback_count": 0, "token": token,
+                   "env_key": ekey,
+                   "runtime_env": spec.runtime_env if ekey else None}
             spill_hops = 0
             while True:
                 if spill_hops >= 8:
@@ -2070,9 +2118,13 @@ class NormalTaskSubmitter:
                     msg["spillback_count"] = 0
                     conn = await self._lease_target(spec)
                     continue
-                # infeasible
-                err = RaySystemError(
-                    f"cannot schedule task: {resp.get('reason', 'infeasible resources')}")
+                # terminal: infeasible resources or runtime-env setup failure
+                if resp["type"] == "env_failed":
+                    err: Exception = RuntimeEnvSetupError(
+                        resp.get("reason", "runtime env setup failed"))
+                else:
+                    err = RaySystemError(
+                        f"cannot schedule task: {resp.get('reason', 'infeasible resources')}")
                 while st["pending"]:
                     sp, holds = st["pending"].popleft()
                     self.cw.fail_task(sp, err, holds)

@@ -30,6 +30,7 @@ import subprocess
 import sys
 import time
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import rpc
@@ -48,9 +49,10 @@ class _LeaseCancelled(Exception):
 class WorkerHandle:
     __slots__ = ("worker_id", "proc", "conn", "addr", "pid", "state", "lease_id",
                  "is_actor", "actor_id", "started_at", "idle_since",
-                 "leased_since")
+                 "leased_since", "env_key")
 
-    def __init__(self, worker_id: bytes, proc: Optional[subprocess.Popen]):
+    def __init__(self, worker_id: bytes, proc: Optional[subprocess.Popen],
+                 env_key: str = ""):
         self.worker_id = worker_id
         self.proc = proc
         self.conn: Optional[rpc.Connection] = None
@@ -63,6 +65,9 @@ class WorkerHandle:
         self.started_at = time.monotonic()
         self.idle_since = time.monotonic()
         self.leased_since = 0.0  # stamped when state flips to "leased"
+        # isolation-env pool this worker belongs to ("" = default pool;
+        # runtime_env.env_key of the pip/image env it was booted inside)
+        self.env_key = env_key
 
 
 class Bundle:
@@ -108,8 +113,15 @@ class Nodelet:
         self.waiters: Dict[ObjectID, List[asyncio.Future]] = {}
 
         self.workers: Dict[bytes, WorkerHandle] = {}
-        self._pop_queue: deque = deque()  # futures waiting for an idle worker
+        # (future, env_key) pairs waiting for an idle worker of that pool
+        self._pop_queue: deque = deque()
         self._starting_count = 0
+        self._starting_by_key: Dict[str, int] = {}
+        # env_key -> worker-launch adjustments (venv python / image wrap),
+        # resolved once per key by _prepare_env and reused by every spawn
+        self._env_launch: Dict[str, dict] = {}
+        self._env_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="rtpu-envs")
 
         self.leases: Dict[int, dict] = {}
         self._lease_seq = 0
@@ -542,7 +554,7 @@ class Nodelet:
         return True
 
     # ------------------------------------------------------------ worker pool
-    def _spawn_worker(self) -> WorkerHandle:
+    def _spawn_worker(self, env_key: str = "") -> WorkerHandle:
         worker_id = WorkerID.from_random()
         log_dir = os.path.join(self.session_dir, "logs")
         os.makedirs(log_dir, exist_ok=True)
@@ -550,20 +562,41 @@ class Nodelet:
         env = dict(os.environ)
         env.update(RayConfig.overrides_as_env())
         env["RAY_TPU_NODE_ID"] = self.node_id.hex()
+        launch = self._env_launch.get(env_key) if env_key else None
+        python = sys.executable
+        if launch is not None and launch.get("python"):
+            # venv worker: the framework itself must stay importable from
+            # the venv interpreter (--system-site-packages covers installed
+            # deps; PYTHONPATH covers a source checkout)
+            python = launch["python"]
+            repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+            env["PYTHONPATH"] = repo_root + (
+                os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
         cmd = [
-            sys.executable, "-u", "-m", "ray_tpu._private.worker_main",
+            python, "-u", "-m", "ray_tpu._private.worker_main",
             "--nodelet-host", self.addr[0], "--nodelet-port", str(self.addr[1]),
             "--gcs-host", self.gcs_addr[0], "--gcs-port", str(self.gcs_addr[1]),
             "--worker-id", worker_id.hex(),
             "--node-id", self.node_id.hex(),
             "--session-dir", self.session_dir,
         ]
+        if launch is not None and launch.get("image"):
+            from ray_tpu.runtime_env.container import wrap_worker_command
+
+            cmd, extra_env = wrap_worker_command(
+                launch["image"], cmd, env, self.session_dir,
+                launch.get("image_args"))
+            env.update(extra_env)
         proc = subprocess.Popen(cmd, stdout=out, stderr=subprocess.STDOUT, env=env,
                                 cwd=os.getcwd())
         out.close()
-        h = WorkerHandle(worker_id.binary(), proc)
+        h = WorkerHandle(worker_id.binary(), proc, env_key=env_key)
         self.workers[worker_id.binary()] = h
         self._starting_count += 1
+        if env_key:
+            self._starting_by_key[env_key] = \
+                self._starting_by_key.get(env_key, 0) + 1
         return h
 
     async def rpc_register_worker(self, conn, msg):
@@ -577,52 +610,90 @@ class Nodelet:
         h.state = "idle"
         h.idle_since = time.monotonic()
         self._starting_count = max(0, self._starting_count - 1)
+        if h.env_key:
+            self._starting_by_key[h.env_key] = max(
+                0, self._starting_by_key.get(h.env_key, 0) - 1)
         conn.context["worker_id"] = wid
         self._fulfill_pops()
         return {"ok": True}
 
-    def _idle_workers(self) -> List[WorkerHandle]:
-        return [w for w in self.workers.values() if w.state == "idle"]
+    def _idle_workers(self, env_key: str = "") -> List[WorkerHandle]:
+        return [w for w in self.workers.values()
+                if w.state == "idle" and w.env_key == env_key]
 
     def _fulfill_pops(self):
+        # match waiters to idle workers of the SAME env pool; leave
+        # unmatched waiters queued (their pool's worker is still booting)
+        unmatched: deque = deque()
         while self._pop_queue:
-            idle = self._idle_workers()
-            if not idle:
-                break
-            fut = self._pop_queue.popleft()
+            fut, env_key = self._pop_queue.popleft()
             if fut.done():
+                continue
+            idle = self._idle_workers(env_key)
+            if not idle:
+                unmatched.append((fut, env_key))
                 continue
             w = idle[0]
             w.state = "leased"
             w.leased_since = time.monotonic()
             fut.set_result(w)
+        self._pop_queue = unmatched
         # Maintain pipeline: spawn if LIVE demand outstrips starting workers —
         # cancelled pops (done futures) must not trigger spawns, or a drained
         # burst leaves a late wave of workers booting (pure CPU theft on small
-        # hosts) with no tasks to run.
-        live = sum(1 for f in self._pop_queue if not f.done())
-        deficit = live - self._starting_count
-        for _ in range(min(max(deficit, 0), RayConfig.maximum_startup_concurrency - self._starting_count)):
-            self._spawn_worker()
+        # hosts) with no tasks to run.  Deficits are per env pool: a venv
+        # waiter is never satisfied by a default-pool boot.
+        live_by_key: Dict[str, int] = {}
+        for f, k in self._pop_queue:
+            if not f.done():
+                live_by_key[k] = live_by_key.get(k, 0) + 1
+        budget = RayConfig.maximum_startup_concurrency - self._starting_count
+        for k, live in live_by_key.items():
+            starting = self._starting_by_key.get(k, 0) if k else (
+                self._starting_count
+                - sum(self._starting_by_key.values()))
+            deficit = live - starting
+            for _ in range(min(max(deficit, 0), max(budget, 0))):
+                self._spawn_worker(k)
+                budget -= 1
 
-    async def _pop_worker(self, token: Optional[str] = None) -> WorkerHandle:
-        idle = self._idle_workers()
+    async def _pop_worker(self, token: Optional[str] = None,
+                          env_key: str = "") -> WorkerHandle:
+        idle = self._idle_workers(env_key)
         if idle:
             w = idle[0]
             w.state = "leased"
             w.leased_since = time.monotonic()
             return w
         fut = asyncio.get_event_loop().create_future()
-        self._pop_queue.append(fut)
+        self._pop_queue.append((fut, env_key))
         if token:
             self._lease_waiters[token] = fut
-        if self._starting_count < RayConfig.maximum_startup_concurrency:
-            self._spawn_worker()
+        starting_here = self._starting_by_key.get(env_key, 0) if env_key \
+            else self._starting_count - sum(self._starting_by_key.values())
+        if self._starting_count < RayConfig.maximum_startup_concurrency \
+                or (env_key and starting_here == 0):
+            self._spawn_worker(env_key)
         try:
             return await fut
         finally:
             if token:
                 self._lease_waiters.pop(token, None)
+
+    async def _prepare_env(self, env_key: str, runtime_env: dict) -> None:
+        """Resolve an isolation env (pip venv build / container image) into
+        launch adjustments, cached per env_key.  Runs in the env thread pool
+        so a venv build never blocks the event loop — the nodelet plays the
+        reference runtime-env agent's role in-process (reference:
+        runtime_env/agent/runtime_env_agent.py GetOrCreateRuntimeEnv)."""
+        if env_key in self._env_launch:
+            return
+        from ray_tpu import runtime_env as renv_mod
+
+        launch = await asyncio.get_event_loop().run_in_executor(
+            self._env_pool, renv_mod.prepare_worker_launch,
+            runtime_env, self.session_dir)
+        self._env_launch[env_key] = launch or {}
 
     async def rpc_cancel_lease_requests(self, conn, msg):
         """Client gave up on outstanding lease requests (its task queue
@@ -641,7 +712,7 @@ class Nodelet:
         costs ~2 s of pure CPU to start, and on small hosts a wave of
         no-longer-needed boots visibly steals the cores from whatever runs
         next.  Booted (idle) workers are kept — they are already paid for."""
-        if any(not f.done() for f in self._pop_queue):
+        if any(not f.done() for f, _k in self._pop_queue):
             return
         # leases queued on resources will need workers the moment capacity
         # frees — their boots are not surplus
@@ -722,6 +793,9 @@ class Nodelet:
         self.workers.pop(w.worker_id, None)
         if prev_state == "starting":
             self._starting_count = max(0, self._starting_count - 1)
+            if w.env_key:
+                self._starting_by_key[w.env_key] = max(
+                    0, self._starting_by_key.get(w.env_key, 0) - 1)
             # A booting worker died (crash or surplus reap).  Live pops may
             # have been counting on it; without a re-pump they would wait
             # forever — nothing else spawns until the next register/return.
@@ -934,8 +1008,18 @@ class Nodelet:
             finally:
                 if token:
                     self._lease_waiters.pop(token, None)
+        env_key = msg.get("env_key") or ""
+        if env_key:
+            try:
+                await self._prepare_env(env_key, msg.get("runtime_env") or {})
+            except Exception as e:
+                logger.warning("runtime env %s setup failed: %r", env_key, e)
+                self._release(resources, bundle)
+                self._pump_queued_leases()
+                return {"type": "env_failed",
+                        "reason": f"runtime env setup failed: {e}"}
         try:
-            w = await self._pop_worker(token)
+            w = await self._pop_worker(token, env_key)
         except _LeaseCancelled:
             self._release(resources, bundle)
             self._pump_queued_leases()  # freed capacity may unblock waiters
@@ -1005,7 +1089,29 @@ class Nodelet:
                 # wait_for cancelled fut; the pump skips done futures, so the
                 # reservation was never made for us.
                 return {"ok": False, "reason": "timed out waiting for resources"}
-        w = await self._pop_worker()
+        from ray_tpu import runtime_env as renv_mod
+
+        env_key = renv_mod.env_key(spec.runtime_env)
+        if env_key:
+            try:
+                await self._prepare_env(env_key, spec.runtime_env)
+            except Exception as e:
+                import pickle
+
+                from ray_tpu.exceptions import RuntimeEnvSetupError
+
+                logger.warning("actor runtime env %s setup failed: %r",
+                               env_key, e)
+                self._release(spec.resources, bundle)
+                self._pump_queued_leases()
+                # carry a pickled error: the GCS treats error-bearing
+                # replies as deterministic failures (actor marked DEAD)
+                # rather than retrying the broken env forever
+                return {"ok": False,
+                        "reason": f"runtime env setup failed: {e}",
+                        "error": pickle.dumps(RuntimeEnvSetupError(
+                            f"runtime env setup failed: {e}"))}
+        w = await self._pop_worker(env_key=env_key)
         self._lease_seq += 1
         w.lease_id = self._lease_seq
         w.is_actor = True

@@ -392,6 +392,11 @@ class PlasmaClient:
     # store's name sequence is monotonic), so a cached mapping is always
     # the right inode.
     _WRITE_CACHE_BYTES = 256 * 1024 * 1024
+    # A mapping of a segment the server has since unlinked can never hit
+    # again (the name is gone forever) but still pins its pages outside the
+    # store's accounting — drop any entry idle this long so stale mappings
+    # are bounded in time, not only by budget pressure.
+    _WRITE_CACHE_IDLE_S = 30.0
 
     def __init__(self, io, conn):
         # io: EventLoopThread, conn: Connection to the local nodelet
@@ -410,10 +415,18 @@ class PlasmaClient:
         """Returns (mapping, cached).  Cached mappings must be released via
         _release_write (not closed); uncached ones are the caller's to
         close."""
+        now = time.monotonic()
         with self._write_lock:
+            # time-bounded pruning of idle mappings (see _WRITE_CACHE_IDLE_S)
+            for k in [k for k, v in self._write_cache.items()
+                      if v[1] == 0 and now - v[2] > self._WRITE_CACHE_IDLE_S]:
+                old = self._write_cache.pop(k)
+                self._write_cache_bytes -= old[0].size
+                old[0].close()
             ent = self._write_cache.get(name)
             if ent is not None:
                 ent[1] += 1
+                ent[2] = now
                 self._write_cache.move_to_end(name)
                 return ent[0], True
         shm = _attach_shm(name)
@@ -424,6 +437,7 @@ class PlasmaClient:
             if name in self._write_cache:  # raced with another thread
                 ent = self._write_cache[name]
                 ent[1] += 1
+                ent[2] = now
                 to_close = shm
             else:
                 while self._write_cache_bytes + size > self._WRITE_CACHE_BYTES:
@@ -434,7 +448,7 @@ class PlasmaClient:
                     old = self._write_cache.pop(victim)
                     self._write_cache_bytes -= old[0].size
                     old[0].close()
-                self._write_cache[name] = [shm, 1]
+                self._write_cache[name] = [shm, 1, now]
                 self._write_cache_bytes += size
                 return shm, True
         to_close.close()
@@ -445,6 +459,7 @@ class PlasmaClient:
             ent = self._write_cache.get(name)
             if ent is not None:
                 ent[1] = max(ent[1] - 1, 0)
+                ent[2] = time.monotonic()
 
     def put(self, oid: ObjectID, flat: memoryview | bytes) -> None:
         """Create + write + seal one object from an already-flat frame."""

@@ -29,7 +29,9 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import subprocess
+import tempfile
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -114,29 +116,50 @@ class GcloudTpuApi(TpuApi):
         self.version = version
         self.startup_script = startup_script
 
-    def _run(self, *args: str, check: bool = False) -> str:
+    def _run(self, *args: str, check: bool = False,
+             fmt: Optional[str] = None) -> str:
         cmd = ["gcloud", "compute", "tpus", "tpu-vm", *args,
-               f"--project={self.project}", f"--zone={self.zone}",
-               "--format=value(state)"]
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=600)
+               f"--project={self.project}", f"--zone={self.zone}"]
+        if fmt:
+            # only state-reading subcommands want machine formatting;
+            # --format on create/ssh changes nothing but clutters errors
+            cmd.append(f"--format={fmt}")
+        proc = self._exec(cmd)
         if check and proc.returncode != 0:
             raise RuntimeError(
                 f"gcloud {' '.join(args)} failed (rc={proc.returncode}): "
                 f"{proc.stderr.strip()[:500]}")
         return proc.stdout.strip()
 
+    def _exec(self, cmd: List[str]) -> "subprocess.CompletedProcess":
+        """Seam for transcript-replay tests (tests/test_cluster_launcher.py)."""
+        return subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600)
+
     def create_slice(self, name, pod_type, resources_per_host):
-        self._run("create", name, f"--accelerator-type={pod_type}",
-                  f"--version={self.version}",
-                  f"--metadata=startup-script={self.startup_script}",
-                  check=True)
+        # --metadata-from-file: a startup script containing ',' or '='
+        # would be misparsed by gcloud's inline --metadata key=value syntax
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".sh", prefix="rtpu-startup-",
+                delete=False) as f:
+            f.write(self.startup_script)
+            script_path = f.name
+        try:
+            self._run("create", name, f"--accelerator-type={pod_type}",
+                      f"--version={self.version}",
+                      f"--metadata-from-file=startup-script={script_path}",
+                      check=True)
+        finally:
+            try:
+                os.unlink(script_path)
+            except OSError:
+                pass
 
     def delete_slice(self, name):
         self._run("delete", name, "--quiet")
 
     def slice_state(self, name):
-        out = self._run("describe", name)
+        out = self._run("describe", name, fmt="value(state)")
         return out or "DELETED"
 
     def host_running(self, name, worker_index):
@@ -195,11 +218,13 @@ class FakeTpuCloud(TpuApi):
             with self._lock:
                 entry = self._slices.get(name)
                 if entry is None or entry["state"] == "DELETED":
+                    logger.info("fake slice %s deleted mid-provision", name)
                     for node in hosts.values():  # deleted mid-provision
                         node.stop()
                     return
                 entry["hosts"] = hosts
                 entry["state"] = "READY"
+                logger.info("fake slice %s READY (%d hosts)", name, n_hosts)
 
         threading.Thread(target=provision, daemon=True,
                          name=f"tpu-provision-{name}").start()
@@ -208,10 +233,13 @@ class FakeTpuCloud(TpuApi):
         with self._lock:
             entry = self._slices.get(name)
             if entry is None:
+                logger.info("fake delete_slice(%s): unknown slice", name)
                 return
             entry["state"] = "DELETED"
             hosts = dict(entry["hosts"])
             entry["hosts"] = {}
+        logger.info("fake slice %s DELETED (stopping %d hosts)",
+                    name, len(hosts))
         for node in hosts.values():
             node.stop()
 

@@ -122,7 +122,13 @@ class ShmChannel:
 
     def _bump(self) -> None:
         """Publish notification: bump the shared futex word (native waiters
-        re-check on every bump) and FUTEX_WAKE when the lib is loaded."""
+        re-check on every bump) and FUTEX_WAKE when the lib is loaded.
+
+        The Python read-modify-write here is NOT atomic against a peer's
+        native ``fetch_add``; a lost increment is tolerated by design — a
+        native waiter that slept through the publish re-polls within 50 ms
+        (the C side's re-poll cap in ch_wait, _native/channel.cpp), so the
+        worst case is bounded extra latency, never a lost message."""
         buf = self._shm.buf
         word = int.from_bytes(buf[32:36], "little")
         buf[32:36] = ((word + 1) & 0xFFFFFFFF).to_bytes(4, "little")

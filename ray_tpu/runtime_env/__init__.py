@@ -1,32 +1,44 @@
 """Per-task/actor runtime environments.
 
 Counterpart of the reference's runtime-env system (reference:
-python/ray/runtime_env/runtime_env.py:152 RuntimeEnv and the plugin set in
-python/ray/_private/runtime_env/{working_dir,py_modules}.py), scoped to what a
-TPU pod actually needs: ``env_vars`` (config/flags for jax, XLA, HF caches),
-``working_dir`` (run user code from a project directory) and ``py_modules``
-(extra import roots).  conda/pip/container plugins are deliberately out of
-scope — TPU pods run a single baked image, so new interpreters per task are
-an anti-pattern here; the validation rejects those keys loudly rather than
-silently ignoring them.
+python/ray/runtime_env/runtime_env.py:152 RuntimeEnv; plugins in
+python/ray/_private/runtime_env/{working_dir,py_modules,pip,image_uri}.py;
+creation owned by runtime_env/agent/runtime_env_agent.py).  Two tiers:
+
+- **In-process fields** — ``env_vars``, ``working_dir``, ``py_modules`` —
+  applied by the executing worker around the task (save/restore for leased
+  workers, permanent for dedicated actor workers).
+- **Isolation fields** — ``pip`` (hermetic venv, see
+  :mod:`ray_tpu.runtime_env.pip`) and ``image_uri`` (container, see
+  :mod:`ray_tpu.runtime_env.container`) — these change the worker PROCESS
+  itself, so they are honored at spawn time by the nodelet: the worker pool
+  is partitioned by :func:`env_key`, and a lease with a pip/image_uri env is
+  only ever granted a worker booted inside that env.  There is no separate
+  agent process: the nodelet prepares envs in a thread-pool executor, which
+  plays the reference agent's role without another daemon per node.
+
+``conda`` is rejected: a conda solve per task is the wrong tool on a TPU pod
+(minutes of solver time, gigabytes per env); pip-on-venv and container
+images cover the actual isolation needs.
 
 Mechanics: the environment travels inside the TaskSpec.  Workers are leased
-per scheduling class, which already includes the runtime env
+per scheduling class, which includes the runtime env
 (task_spec.py scheduling_class), so one worker never interleaves two
-environments mid-lease; the executing worker applies the env around task
-execution (save/restore for leased task workers, permanent for dedicated
-actor workers).
+environments mid-lease.
 """
 
 from __future__ import annotations
 
 import contextlib
+import hashlib
+import json
 import os
 import sys
 from typing import Dict, List, Optional
 
-_SUPPORTED = ("env_vars", "working_dir", "py_modules")
-_UNSUPPORTED = ("conda", "pip", "uv", "container", "image_uri", "java_jars")
+_SUPPORTED = ("env_vars", "working_dir", "py_modules", "pip", "image_uri",
+              "container_run_args")
+_UNSUPPORTED = ("conda", "uv", "container", "java_jars")
 
 
 class RuntimeEnv(dict):
@@ -35,14 +47,15 @@ class RuntimeEnv(dict):
 
     def __init__(self, *, env_vars: Optional[Dict[str, str]] = None,
                  working_dir: Optional[str] = None,
-                 py_modules: Optional[List[str]] = None, **kwargs):
+                 py_modules: Optional[List[str]] = None,
+                 pip=None, image_uri: Optional[str] = None,
+                 container_run_args: Optional[List[str]] = None, **kwargs):
         super().__init__()
         for k in kwargs:
             if k in _UNSUPPORTED:
                 raise ValueError(
                     f"runtime_env field {k!r} is not supported on this "
-                    f"runtime (single-image TPU pods); supported: "
-                    f"{_SUPPORTED}")
+                    f"runtime; supported: {_SUPPORTED}")
             raise ValueError(f"unknown runtime_env field {k!r}; "
                              f"supported: {_SUPPORTED}")
         if env_vars is not None:
@@ -55,6 +68,25 @@ class RuntimeEnv(dict):
             if not isinstance(py_modules, (list, tuple)):
                 raise TypeError("py_modules must be a list of paths")
             self["py_modules"] = [str(p) for p in py_modules]
+        if pip is not None:
+            from ray_tpu.runtime_env.pip import normalize_pip_spec
+
+            self["pip"] = normalize_pip_spec(pip)
+        if image_uri is not None:
+            if not isinstance(image_uri, str) or not image_uri:
+                raise TypeError("image_uri must be a non-empty string")
+            self["image_uri"] = image_uri
+        if container_run_args is not None:
+            if not isinstance(container_run_args, (list, tuple)) or not all(
+                    isinstance(a, str) for a in container_run_args):
+                raise TypeError("container_run_args must be a list of str")
+            if "image_uri" not in self:
+                raise ValueError("container_run_args requires image_uri")
+            self["container_run_args"] = list(container_run_args)
+        if "pip" in self and "image_uri" in self:
+            raise ValueError(
+                "pip and image_uri are mutually exclusive (bake the "
+                "packages into the image instead)")
 
 
 def validate_env_vars(env_vars) -> None:
@@ -78,6 +110,43 @@ def validate(runtime_env: Optional[dict]) -> Optional[dict]:
     if not isinstance(runtime_env, dict):
         raise TypeError("runtime_env must be a dict or RuntimeEnv")
     return dict(RuntimeEnv(**runtime_env))
+
+
+def env_key(runtime_env: Optional[dict]) -> str:
+    """Isolation key: non-empty iff the env changes the worker PROCESS
+    (pip venv / container image) rather than just in-process state.  Workers
+    are pooled per key — "" is the default shared pool (reference analogue:
+    the runtime-env hash in WorkerPool's PopWorker request,
+    src/ray/raylet/worker_pool.h)."""
+    if not runtime_env:
+        return ""
+    iso = {k: runtime_env[k] for k in ("pip", "image_uri",
+                                       "container_run_args")
+           if k in runtime_env}
+    if not iso:
+        return ""
+    return hashlib.sha1(
+        json.dumps(iso, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def prepare_worker_launch(runtime_env: Optional[dict], session_dir: str
+                          ) -> Optional[dict]:
+    """Resolve an isolation env into worker-launch adjustments:
+    ``{"python": ..., "env": {...}, "wrap": callable|None}``.
+    BLOCKING on a pip cache miss (venv build) — the nodelet calls this from
+    an executor thread.  Returns None for non-isolating envs."""
+    if not runtime_env:
+        return None
+    if "pip" in runtime_env:
+        from ray_tpu.runtime_env.pip import get_or_create
+
+        python = get_or_create(session_dir, runtime_env["pip"])
+        return {"python": python, "env": {}, "image": None}
+    if "image_uri" in runtime_env:
+        return {"python": None, "env": {},
+                "image": runtime_env["image_uri"],
+                "image_args": runtime_env.get("container_run_args", [])}
+    return None
 
 
 @contextlib.contextmanager

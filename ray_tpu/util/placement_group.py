@@ -40,9 +40,9 @@ class PlacementGroup:
         here; a direct blocking call is the natural shape without a dummy
         task round-trip.)"""
         core = require_core()
-        return bool(core.io.run(core.gcs_conn.call(
+        return bool(core.gcs_call_sync(
             "wait_placement_group_ready",
-            {"pg_id": self.id.binary(), "timeout": timeout})))
+            {"pg_id": self.id.binary(), "timeout": timeout}))
 
     def wait(self, timeout_seconds: Optional[float] = None) -> bool:
         """Reference-compatible alias of ready()."""
@@ -78,8 +78,8 @@ class PlacementGroup:
 
     def _info(self) -> Optional[dict]:
         core = require_core()
-        return core.io.run(core.gcs_conn.call(
-            "get_placement_group", {"pg_id": self.id.binary()}))
+        return core.gcs_call_sync(
+            "get_placement_group", {"pg_id": self.id.binary()})
 
     def __repr__(self):
         return f"PlacementGroup({self.id.hex()[:8]}, {self._strategy})"
@@ -122,15 +122,15 @@ def remove_placement_group(pg: PlacementGroup) -> None:
     """Release all bundles; queued leases against them fail over to the node
     pool (reference: util/placement_group.py remove_placement_group)."""
     core = require_core()
-    core.io.run(core.gcs_conn.call(
-        "remove_placement_group", {"pg_id": pg.id.binary()}))
+    core.gcs_call_sync(
+        "remove_placement_group", {"pg_id": pg.id.binary()})
 
 
 def placement_group_table() -> List[dict]:
     """All placement groups' info (reference: util/placement_group.py
     placement_group_table)."""
     core = require_core()
-    infos = core.io.run(core.gcs_conn.call("get_all_placement_group_info", None))
+    infos = core.gcs_call_sync("get_all_placement_group_info", None)
     return [{**i, "pg_id": i["pg_id"].hex(),
              "bundle_nodes": [n.hex() if n else None for n in i["bundle_nodes"]]}
             for i in infos]
@@ -139,7 +139,7 @@ def placement_group_table() -> List[dict]:
 def get_placement_group(name: str) -> PlacementGroup:
     """Look up a placement group by name."""
     core = require_core()
-    infos = core.io.run(core.gcs_conn.call("get_all_placement_group_info", None))
+    infos = core.gcs_call_sync("get_all_placement_group_info", None)
     for i in infos:
         if i.get("name") == name and i["state"] != "REMOVED":
             return PlacementGroup(PlacementGroupID(i["pg_id"]), i["bundles"],

@@ -62,7 +62,7 @@ def test_working_dir_and_py_modules(tmp_path, cluster):
 
 def test_validation_rejects_unsupported(cluster):
     with pytest.raises(ValueError, match="not supported"):
-        RuntimeEnv(pip=["requests"])
+        RuntimeEnv(conda={"dependencies": ["pip"]})
     with pytest.raises(ValueError, match="unknown runtime_env field"):
         RuntimeEnv(bogus=1)
     with pytest.raises(TypeError):

@@ -1,0 +1,203 @@
+"""Runtime-env isolation: pip venvs + container images (reference test
+strategy: python/ray/tests/test_runtime_env_conda_and_pip.py,
+test_runtime_env_container.py — tasks in one cluster running under different
+pinned package versions).
+
+Offline by construction: the wheels are hand-built in tmp_path and installed
+with ``--no-index --find-links`` (TPU pods often have no egress; the pip
+plugin must work hermetically)."""
+
+import base64
+import csv
+import hashlib
+import io
+import os
+import sys
+import zipfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu.runtime_env import RuntimeEnv, env_key
+
+
+def _make_wheel(dirpath, name, version):
+    os.makedirs(dirpath, exist_ok=True)
+    whl = os.path.join(dirpath, f"{name}-{version}-py3-none-any.whl")
+    files = {
+        f"{name}/__init__.py": f'__version__ = "{version}"\n',
+        f"{name}-{version}.dist-info/METADATA":
+            f"Metadata-Version: 2.1\nName: {name}\nVersion: {version}\n",
+        f"{name}-{version}.dist-info/WHEEL":
+            "Wheel-Version: 1.0\nGenerator: rtpu-test\n"
+            "Root-Is-Purelib: true\nTag: py3-none-any\n",
+    }
+    rows = []
+    with zipfile.ZipFile(whl, "w") as z:
+        for path, content in files.items():
+            data = content.encode()
+            z.writestr(path, data)
+            digest = base64.urlsafe_b64encode(
+                hashlib.sha256(data).digest()).rstrip(b"=").decode()
+            rows.append((path, f"sha256={digest}", str(len(data))))
+        rec = f"{name}-{version}.dist-info/RECORD"
+        buf = io.StringIO()
+        w = csv.writer(buf)
+        for r in rows:
+            w.writerow(r)
+        w.writerow((rec, "", ""))
+        z.writestr(rec, buf.getvalue())
+    return whl
+
+
+def test_validation_and_env_key():
+    with pytest.raises(ValueError):
+        RuntimeEnv(conda={"dependencies": ["pip"]})
+    with pytest.raises(ValueError):
+        RuntimeEnv(pip=["a==1"], image_uri="img:1")  # mutually exclusive
+    with pytest.raises(ValueError):
+        RuntimeEnv(container_run_args=["--privileged"])  # needs image_uri
+    # normalization: order-insensitive, deduped
+    a = RuntimeEnv(pip=["b==2", "a==1", "a==1"])
+    b = RuntimeEnv(pip=["a==1", "b==2"])
+    assert a["pip"] == b["pip"] == ["a==1", "b==2"]
+    assert env_key(a) == env_key(b) != ""
+    # in-process-only envs share the default pool
+    assert env_key({"env_vars": {"X": "1"}}) == ""
+    assert env_key(None) == ""
+    # image envs partition too
+    assert env_key({"image_uri": "img:1"}) != env_key({"image_uri": "img:2"})
+
+
+@pytest.fixture
+def iso_cluster(tmp_path, monkeypatch):
+    """Fresh cluster whose nodelet sees the offline-pip + fake-container
+    config (env vars propagate to the node subprocesses)."""
+    wheel_dir = str(tmp_path / "wheels")
+    _make_wheel(wheel_dir, "toydep", "1.0")
+    _make_wheel(wheel_dir, "toydep", "2.0")
+    monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_PIP_NO_INDEX", "1")
+    monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_PIP_FIND_LINKS", wheel_dir)
+    monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_CONTAINER_RUNTIME", "fake")
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2)
+    yield wheel_dir
+    ray_tpu.shutdown()
+
+
+def test_two_pinned_versions_one_cluster(iso_cluster):
+    """The reference's headline runtime-env property: tasks in the same
+    cluster run under different pinned package versions, and the driver
+    process is untouched."""
+
+    @ray_tpu.remote
+    def dep_version():
+        import toydep
+
+        return toydep.__version__, sys.executable
+
+    v1 = dep_version.options(
+        runtime_env={"pip": ["toydep==1.0"]}).remote()
+    v2 = dep_version.options(
+        runtime_env={"pip": ["toydep==2.0"]}).remote()
+    (ver1, py1), (ver2, py2) = ray_tpu.get([v1, v2], timeout=600)
+    assert ver1 == "1.0" and ver2 == "2.0"
+    assert py1 != py2, "both versions ran in the same interpreter"
+    assert "runtime_envs/pip/" in py1 and "runtime_envs/pip/" in py2
+    with pytest.raises(ImportError):
+        import toydep  # noqa: F401  — driver env stays clean
+
+
+def test_pip_env_cached_and_reused(iso_cluster):
+    """Same spec twice -> same venv (hash-keyed cache), no rebuild."""
+
+    @ray_tpu.remote
+    def exe():
+        return sys.executable
+
+    spec = {"pip": ["toydep==1.0"]}
+    first = ray_tpu.get(exe.options(runtime_env=spec).remote(), timeout=600)
+    second = ray_tpu.get(exe.options(runtime_env=spec).remote(), timeout=120)
+    assert first == second
+
+
+def test_pip_composes_with_working_dir_and_env_vars(iso_cluster, tmp_path):
+    wd = tmp_path / "proj"
+    wd.mkdir()
+    (wd / "data.txt").write_text("payload-42")
+
+    @ray_tpu.remote
+    def composed():
+        import toydep
+
+        with open("data.txt") as f:  # working_dir is the cwd
+            data = f.read()
+        return toydep.__version__, data, os.environ.get("MY_FLAG")
+
+    out = ray_tpu.get(composed.options(runtime_env={
+        "pip": ["toydep==2.0"],
+        "working_dir": str(wd),
+        "env_vars": {"MY_FLAG": "on"},
+    }).remote(), timeout=600)
+    assert out == ("2.0", "payload-42", "on")
+
+
+def test_pip_setup_failure_surfaces(iso_cluster):
+    from ray_tpu.exceptions import RuntimeEnvSetupError
+
+    @ray_tpu.remote
+    def nope():
+        return 1
+
+    ref = nope.options(
+        runtime_env={"pip": ["definitely-not-a-real-pkg==9.9"]}).remote()
+    with pytest.raises(RuntimeEnvSetupError):
+        ray_tpu.get(ref, timeout=600)
+
+
+def test_container_image_fake_runtime(iso_cluster):
+    """image_uri workers are launched through the container runtime seam;
+    the fake runtime proves the wrap (image + run args) reached the worker
+    launch (reference: image_uri plugin + podman run)."""
+
+    @ray_tpu.remote
+    def inside():
+        return (os.environ.get("RAY_TPU_CONTAINER_IMAGE"),
+                os.environ.get("RAY_TPU_CONTAINER_ARGS"))
+
+    img, args = ray_tpu.get(inside.options(runtime_env={
+        "image_uri": "fake.registry/tpu-worker:1",
+        "container_run_args": ["--privileged"],
+    }).remote(), timeout=300)
+    assert img == "fake.registry/tpu-worker:1"
+    assert args == "--privileged"
+
+
+def test_actor_env_setup_failure_is_terminal(iso_cluster):
+    """A deterministically broken env must mark the actor DEAD (with the
+    setup error), not retry the pip install forever (reference: creation
+    task failure semantics)."""
+    from ray_tpu.exceptions import RayActorError
+
+    @ray_tpu.remote
+    class Broken:
+        def ping(self):
+            return 1
+
+    a = Broken.options(
+        runtime_env={"pip": ["definitely-not-a-real-pkg==9.9"]}).remote()
+    with pytest.raises(RayActorError, match="runtime env setup failed"):
+        ray_tpu.get(a.ping.remote(), timeout=600)
+
+
+def test_actor_in_pip_env(iso_cluster):
+    @ray_tpu.remote
+    class Pinned:
+        def version(self):
+            import toydep
+
+            return toydep.__version__
+
+    a = Pinned.options(runtime_env={"pip": ["toydep==1.0"]}).remote()
+    assert ray_tpu.get(a.version.remote(), timeout=600) == "1.0"
+    ray_tpu.kill(a)

@@ -52,8 +52,12 @@ def test_task_events_flow_to_state_api(ray_start_regular):
     with pytest.raises(ValueError):
         ray_tpu.get(_tracked_fail.remote())
 
+    # SUBMITTED is emitted driver-side and flushed on the periodic tick
+    # (only terminal states flush eagerly), so FINISHED can be visible
+    # before SUBMITTED arrives — wait for the full lifecycle.
     rows = _wait_for_tasks(lambda rows: any(
         r["name"] == "_tracked_add" and r["state"] == "FINISHED"
+        and {"SUBMITTED", "RUNNING", "FINISHED"} <= set(r["state_ts"])
         for r in rows) and any(
         r["name"] == "_tracked_fail" and r["state"] == "FAILED"
         for r in rows))
