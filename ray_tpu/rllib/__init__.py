@@ -6,8 +6,9 @@ Algorithm is the Tune-trainable driver loop).
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 
-__all__ = ["Algorithm", "AlgorithmConfig", "IMPALA", "IMPALAConfig",
-           "PPO", "PPOConfig"]
+__all__ = ["Algorithm", "AlgorithmConfig", "DQN", "DQNConfig",
+           "IMPALA", "IMPALAConfig", "PPO", "PPOConfig"]

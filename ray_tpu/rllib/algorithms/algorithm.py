@@ -30,6 +30,9 @@ class AlgorithmConfig:
         self.seed: int = 0
         self.model: Dict[str, Any] = {"hidden": (64, 64)}
         self.training_params: Dict[str, Any] = {}
+        # multi-agent (empty = single-agent)
+        self.policies: list = []
+        self.policy_mapping_fn = lambda agent_id: agent_id
 
     # ------------------------------------------------------ fluent setters
     def environment(self, env: str) -> "AlgorithmConfig":
@@ -58,6 +61,18 @@ class AlgorithmConfig:
 
     def training(self, **params) -> "AlgorithmConfig":
         self.training_params.update(params)
+        return self
+
+    def multi_agent(self, *, policies=None, policy_mapping_fn=None
+                    ) -> "AlgorithmConfig":
+        """Per-agent policy mapping (reference:
+        algorithm_config.py multi_agent() — policies + policy_mapping_fn).
+        ``policies`` is an iterable of policy ids; ``policy_mapping_fn``
+        maps agent_id -> policy id (default: identity)."""
+        if policies is not None:
+            self.policies = list(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
         return self
 
     def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
@@ -91,19 +106,17 @@ def build_module_spec(config: "AlgorithmConfig") -> Dict[str, Any]:
     }
 
 
-def build_runner_actors(config: "AlgorithmConfig", module_spec: Dict) -> list:
-    """Spawn the EnvRunner actor gang (reference: EnvRunnerGroup)."""
+def build_runner_actors(config: "AlgorithmConfig", runner_cls,
+                        runner_kwargs: Dict[str, Any]) -> list:
+    """Spawn a runner actor gang of any runner class (reference:
+    EnvRunnerGroup) — one CPU each, per-runner decorrelated seeds."""
     import ray_tpu
-    from ray_tpu.rllib.env.env_runner import EnvRunner
 
-    runner_cls = ray_tpu.remote(EnvRunner)
+    remote_cls = ray_tpu.remote(runner_cls)
     return [
-        runner_cls.options(num_cpus=1).remote(
-            env_name=config.env,
-            num_envs=config.num_envs_per_env_runner,
-            rollout_length=config.rollout_fragment_length,
-            module_spec=module_spec,
-            seed=config.seed + 1000 * (i + 1))
+        remote_cls.options(num_cpus=1).remote(
+            **{**runner_kwargs,
+               "seed": runner_kwargs.get("seed", 0) + 1000 * (i + 1)})
         for i in range(config.num_env_runners)
     ]
 

@@ -1,0 +1,392 @@
+"""DQN: off-policy Q-learning with a replay buffer and target network.
+
+Counterpart of the reference's DQN (reference: rllib/algorithms/dqn/dqn.py —
+DQNConfig with replay buffer config, target_network_update_freq,
+epsilon schedule; loss in rllib/algorithms/dqn/torch/dqn_torch_learner.py —
+double-Q + huber).  This is the control flow neither PPO nor IMPALA touches:
+a PERSISTENT learner-local replay buffer, off-policy ratios >> 1 (each
+transition is replayed many times), and a lagged target network synced on an
+env-step schedule.
+
+JAX-first layout: the buffer is host-side numpy ring storage (cheap gather on
+sample; device memory holds only the current batch), and one jitted update
+runs the double-DQN TD loss + adam over a scan of minibatches — U updates
+per call in a single dispatch, no per-update host round-trip.  Exploration
+(epsilon-greedy) runs on the CPU env-runner exactly like the other
+algorithms (SURVEY §3.5: runners are host programs).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import QModule
+
+
+class ReplayBuffer:
+    """Uniform circular transition store (reference:
+    rllib/utils/replay_buffers/replay_buffer.py — storage ring +
+    sample(num_items); prioritized variant left to a later round)."""
+
+    def __init__(self, capacity: int, observation_size: int, seed: int = 0):
+        self.capacity = int(capacity)
+        self.obs = np.empty((capacity, observation_size), np.float32)
+        self.next_obs = np.empty((capacity, observation_size), np.float32)
+        self.actions = np.empty((capacity,), np.int32)
+        self.rewards = np.empty((capacity,), np.float32)
+        self.discounts = np.empty((capacity,), np.float32)
+        self.dones = np.empty((capacity,), np.float32)
+        self._write = 0
+        self.size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add_batch(self, obs, actions, rewards, next_obs, discounts,
+                  dones) -> None:
+        n = len(actions)
+        idx = (self._write + np.arange(n)) % self.capacity
+        self.obs[idx] = obs
+        self.actions[idx] = actions
+        self.rewards[idx] = rewards
+        self.next_obs[idx] = next_obs
+        self.discounts[idx] = discounts
+        self.dones[idx] = dones
+        self._write = int((self._write + n) % self.capacity)
+        self.size = int(min(self.size + n, self.capacity))
+
+    def sample_indices(self, num_batches: int, batch_size: int) -> np.ndarray:
+        return self._rng.integers(0, self.size,
+                                  (num_batches, batch_size))
+
+    def gather(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        return {"obs": self.obs[idx], "actions": self.actions[idx],
+                "rewards": self.rewards[idx], "next_obs": self.next_obs[idx],
+                "discounts": self.discounts[idx], "dones": self.dones[idx]}
+
+
+class QEnvRunner:
+    """Epsilon-greedy n-step transition sampler over K vectorized envs.
+
+    Distinct from the on-policy EnvRunner: off-policy learning needs
+    (s, a, R_n, s'', discount, done) transitions, where R_n is the n-step
+    discounted reward sum, s'' the state n steps ahead (pre-reset
+    ``final_obs`` at episode ends), and ``discount`` the γ^len bootstrap
+    multiplier — episode-end flushes emit shorter windows, so the discount
+    rides the transition instead of being a learner constant (reference:
+    n_step handling in rllib/utils/replay_buffers + DQN loss's gamma**n_step).
+    done means TERMINATED only — bootstrapping continues through time limits.
+    """
+
+    def __init__(self, env_name: str, num_envs: int, rollout_length: int,
+                 module_spec: Dict, seed: int = 0, n_step: int = 3,
+                 gamma: float = 0.99):
+        import sys
+
+        if "jax" in sys.modules:
+            import jax._src.xla_bridge as _xb
+
+            initialized = _xb.backends_are_initialized()
+        else:
+            initialized = False
+        if not initialized:
+            # pin rollout inference to CPU BEFORE the backend initializes
+            # (see EnvRunner.__init__: un-pinned runners on a TPU VM
+            # dispatch every per-step inference to the chip, ~270x slower)
+            from ray_tpu._private.platform import force_cpu_platform
+
+            force_cpu_platform(1)
+        import jax
+
+        from ray_tpu.rllib.env import make_vector_env
+
+        self.env = make_vector_env(env_name, num_envs, seed=seed)
+        self.num_envs = num_envs
+        self.rollout_length = rollout_length
+        self.n_step = int(n_step)
+        self.gamma = float(gamma)
+        self.module = QModule(**module_spec)
+        self.params = None
+        self._rng = np.random.default_rng(seed + 7)
+        self._greedy = jax.jit(self.module.forward_inference)
+        self.obs = self.env.reset()
+        import collections
+
+        # per-env window of up to n pending (obs, action, [rewards...])
+        self._pending = [collections.deque() for _ in range(num_envs)]
+        self._ep_return = np.zeros(num_envs, np.float32)
+        self._recent_returns: "collections.deque" = collections.deque(maxlen=100)
+        self._lifetime_steps = 0
+
+    def _emit(self, out, k, entry, succ_obs, done: bool):
+        obs0, a0, rewards = entry
+        ret = 0.0
+        for r in reversed(rewards):
+            ret = r + self.gamma * ret
+        out["obs"].append(obs0)
+        out["actions"].append(a0)
+        out["rewards"].append(ret)
+        out["next_obs"].append(succ_obs)
+        out["discounts"].append(self.gamma ** len(rewards))
+        out["dones"].append(1.0 if done else 0.0)
+
+    def sample(self, weights=None, epsilon: float = 0.0) -> Dict[str, np.ndarray]:
+        if weights is not None:
+            self.params = weights
+        assert self.params is not None
+        T, K = self.rollout_length, self.num_envs
+        out = {"obs": [], "actions": [], "rewards": [], "next_obs": [],
+               "discounts": [], "dones": []}
+        for t in range(T):
+            greedy = np.asarray(self._greedy(self.params, self.obs))
+            explore = self._rng.random(K) < epsilon
+            actions = np.where(
+                explore,
+                self._rng.integers(0, self.env.num_actions, K),
+                greedy).astype(np.int32)
+            next_obs, rewards, terminated, truncated, info = \
+                self.env.step(actions)
+            done_any = terminated | truncated
+            for k in range(K):
+                pend = self._pending[k]
+                pend.append((self.obs[k].copy(), int(actions[k]), []))
+                for entry in pend:
+                    entry[2].append(float(rewards[k]))
+                if done_any[k]:
+                    # flush every window; successor is the TRUE pre-reset
+                    # state, done only when genuinely terminated
+                    succ = info["final_obs"][k].copy()
+                    while pend:
+                        self._emit(out, k, pend.popleft(), succ,
+                                   bool(terminated[k]))
+                elif len(pend) == self.n_step:
+                    self._emit(out, k, pend.popleft(), next_obs[k].copy(),
+                               False)
+            self._ep_return += rewards
+            for i in np.nonzero(done_any)[0]:
+                self._recent_returns.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+            self.obs = next_obs
+        self._lifetime_steps += T * K
+        return {
+            "obs": np.asarray(out["obs"], np.float32),
+            "actions": np.asarray(out["actions"], np.int32),
+            "rewards": np.asarray(out["rewards"], np.float32),
+            "next_obs": np.asarray(out["next_obs"], np.float32),
+            "discounts": np.asarray(out["discounts"], np.float32),
+            "dones": np.asarray(out["dones"], np.float32),
+        }
+
+    def get_metrics(self) -> Dict:
+        return {
+            "episode_return_mean": (float(np.mean(self._recent_returns))
+                                    if self._recent_returns else float("nan")),
+            "num_episodes": len(self._recent_returns),
+            "num_env_steps_sampled_lifetime": self._lifetime_steps,
+        }
+
+    def ping(self) -> bool:
+        return True
+
+
+def _dqn_update(module, tx, params, target_params, opt_state, batches, *,
+                double_q, tau, use_huber=True):
+    """U minibatch updates under ONE jit: lax.scan over stacked batches
+    (reference loss: dqn_torch_learner.py compute_loss_for_module —
+    double-Q action selection by the online net, evaluation by the target
+    net, huber TD error)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def td_loss(p, target_params, mb):
+        q = module.q_values(p, mb["obs"])
+        q_a = jnp.take_along_axis(
+            q, mb["actions"][..., None].astype(jnp.int32), -1)[..., 0]
+        q_next_target = module.q_values(target_params, mb["next_obs"])
+        if double_q:
+            sel = jnp.argmax(module.q_values(p, mb["next_obs"]), axis=-1)
+            q_next = jnp.take_along_axis(
+                q_next_target, sel[..., None], -1)[..., 0]
+        else:
+            q_next = q_next_target.max(axis=-1)
+        # discounts = gamma^n of each transition's window (n-step returns;
+        # shorter windows at episode ends carry their own multiplier)
+        target = mb["rewards"] + mb["discounts"] * (1.0 - mb["dones"]) \
+            * jax.lax.stop_gradient(q_next)
+        err = q_a - target
+        loss = optax.huber_loss(q_a, target).mean() if use_huber \
+            else 0.5 * jnp.square(q_a - target).mean()
+        return loss, {"td_error_mean": jnp.abs(err).mean(),
+                      "q_mean": q_a.mean()}
+
+    def body(carry, mb):
+        p, tp, s = carry
+        (loss, stats), grads = jax.value_and_grad(
+            lambda pp: td_loss(pp, tp, mb), has_aux=True)(p)
+        updates, s = tx.update(grads, s, p)
+        p = optax.apply_updates(p, updates)
+        # Polyak-averaged target (reference: tau config in DQNConfig);
+        # tau=0 -> hard syncs handled by the caller on a step schedule
+        tp = jax.tree_util.tree_map(
+            lambda t, o: (1.0 - tau) * t + tau * o, tp, p) if tau > 0 else tp
+        return (p, tp, s), {**stats, "total_loss": loss}
+
+    (params, target_params, opt_state), stats = jax.lax.scan(
+        body, (params, target_params, opt_state), batches)
+    return params, target_params, opt_state, jax.tree_util.tree_map(
+        lambda x: x[-1], stats)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_envs_per_env_runner = 16
+        self.rollout_fragment_length = 8
+        self.training_params = {
+            "lr": 2.5e-4,
+            "gamma": 0.99,
+            "buffer_size": 50_000,
+            "train_batch_size": 128,
+            "num_updates_per_iter": 13,
+            # tau=0 -> hard target sync every target_network_update_freq
+            # env steps (the empirically stable default here); tau>0 ->
+            # per-update Polyak averaging
+            "tau": 0.0,
+            "target_network_update_freq": 500,
+            "learning_starts": 10_000,
+            "epsilon_initial": 1.0,
+            "epsilon_final": 0.05,
+            "epsilon_anneal_steps": 250_000,
+            "double_q": True,
+            "dueling": True,
+            "n_step": 3,
+            # MSE, not huber: with huber's capped gradients the few
+            # high-error grounded (terminal) samples cannot outweigh the
+            # many slightly-inflating bootstrapped ones, and Q runs away;
+            # MSE's error-proportional pull self-corrects (measured: huber
+            # diverged to Q~1e7 on CartPole, MSE solves in ~200k steps)
+            "use_huber": False,
+            "grad_clip": 40.0,
+        }
+
+    @property
+    def algo_class(self):
+        return DQN
+
+
+class DQN(Algorithm):
+    def setup(self, config: DQNConfig) -> None:
+        import jax
+        import optax
+
+        from ray_tpu.rllib.algorithms.algorithm import build_module_spec
+
+        if config.learner_platform == "cpu":
+            from ray_tpu._private.platform import force_cpu_platform
+
+            force_cpu_platform(1)
+        spec = build_module_spec(config)
+        p = config.training_params
+        self.module = QModule(observation_size=spec["observation_size"],
+                              num_actions=spec["num_actions"],
+                              hidden=spec["hidden"],
+                              dueling=p.get("dueling", True))
+        self.params = self.module.init(jax.random.PRNGKey(config.seed))
+        # jax arrays are immutable: sharing the pytree IS the snapshot
+        self.target_params = self.params
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(p["grad_clip"]),
+            optax.adam(p["lr"]))
+        self.opt_state = self.tx.init(self.params)
+        self._update = jax.jit(functools.partial(
+            _dqn_update, self.module, self.tx, double_q=p["double_q"],
+            tau=p["tau"], use_huber=p.get("use_huber", True)))
+
+        self.buffer = ReplayBuffer(p["buffer_size"],
+                                   spec["observation_size"],
+                                   seed=config.seed)
+        self._steps_sampled = 0
+        self._last_target_sync = 0
+
+        self._runner_actors = []
+        self._local_runner = None
+        runner_kwargs = dict(
+            env_name=config.env, num_envs=config.num_envs_per_env_runner,
+            rollout_length=config.rollout_fragment_length,
+            module_spec={**spec, "dueling": p.get("dueling", True)},
+            seed=config.seed,
+            n_step=p.get("n_step", 3), gamma=p["gamma"])
+        if config.num_env_runners <= 0:
+            self._local_runner = QEnvRunner(**runner_kwargs)
+        else:
+            from ray_tpu.rllib.algorithms.algorithm import build_runner_actors
+
+            self._runner_actors = build_runner_actors(
+                config, QEnvRunner, runner_kwargs)
+
+    def _epsilon(self) -> float:
+        p = self.config.training_params
+        frac = min(self._steps_sampled / max(p["epsilon_anneal_steps"], 1),
+                   1.0)
+        return float(p["epsilon_initial"]
+                     + frac * (p["epsilon_final"] - p["epsilon_initial"]))
+
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+
+        p = self.config.training_params
+        eps = self._epsilon()
+        if self._local_runner is not None:
+            batches = [self._local_runner.sample(self.params, eps)]
+            metrics = [self._local_runner.get_metrics()]
+        else:
+            wref = ray_tpu.put(self.params)
+            batches = ray_tpu.get([r.sample.remote(wref, eps)
+                                   for r in self._runner_actors])
+            metrics = ray_tpu.get([r.get_metrics.remote()
+                                   for r in self._runner_actors])
+        frag = self.config.rollout_fragment_length \
+            * self.config.num_envs_per_env_runner
+        for b in batches:
+            self.buffer.add_batch(b["obs"], b["actions"], b["rewards"],
+                                  b["next_obs"], b["discounts"], b["dones"])
+            self._steps_sampled += frag
+
+        stats: Dict[str, Any] = {}
+        if self._steps_sampled >= p["learning_starts"]:
+            idx = self.buffer.sample_indices(p["num_updates_per_iter"],
+                                             p["train_batch_size"])
+            stacked = self.buffer.gather(idx)  # (U, B, ...)
+            self.params, self.target_params, self.opt_state, jstats = \
+                self._update(self.params, self.target_params,
+                             self.opt_state, stacked)
+            stats = {k: float(v) for k, v in jstats.items()}
+            if p["tau"] == 0 and self._steps_sampled - self._last_target_sync \
+                    >= p.get("target_network_update_freq", 500):
+                self.target_params = self.params
+                self._last_target_sync = self._steps_sampled
+
+        returns = [m["episode_return_mean"] for m in metrics
+                   if np.isfinite(m["episode_return_mean"])]
+        return {
+            "episode_return_mean": float(np.mean(returns)) if returns
+            else float("nan"),
+            "num_env_steps_sampled_lifetime": self._steps_sampled,
+            "num_episodes": int(sum(m["num_episodes"] for m in metrics)),
+            "epsilon": eps,
+            "replay_buffer_size": self.buffer.size,
+            **{f"learner/{k}": v for k, v in stats.items()},
+        }
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for r in self._runner_actors:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self._runner_actors = []

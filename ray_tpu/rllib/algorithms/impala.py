@@ -59,7 +59,14 @@ class IMPALA(Algorithm):
         if config.num_env_runners <= 0:
             raise ValueError("IMPALA needs actor env-runners "
                              "(num_env_runners >= 1): the sampling is async")
-        self._runners = build_runner_actors(config, self._module_spec)
+        from ray_tpu.rllib.env.env_runner import EnvRunner
+
+        self._runners = build_runner_actors(config, EnvRunner, dict(
+            env_name=config.env,
+            num_envs=config.num_envs_per_env_runner,
+            rollout_length=config.rollout_fragment_length,
+            module_spec=self._module_spec,
+            seed=config.seed))
         # one in-flight sample per runner, launched with the initial weights
         wref = ray_tpu.put(self.learner.get_weights())
         self._inflight: Dict[Any, Any] = {

@@ -85,3 +85,48 @@ class DiscretePolicyModule:
         p = jnp.exp(logp)
         entropy = -jnp.sum(p * logp, axis=-1)
         return logp_a, entropy
+
+
+class QModule:
+    """Dueling Q-network for value-based algorithms (reference: DQN's
+    catalog-built Q head, rllib/algorithms/dqn/torch/dqn_torch_rl_module.py
+    compute_q_values; dueling decomposition Q = V + A - mean(A), the
+    reference's `dueling=True` default for the tuned CartPole example).
+    Relu trunk — value regression wants sharper features than tanh."""
+
+    def __init__(self, observation_size: int, num_actions: int,
+                 hidden: Sequence[int] = (64, 64), dueling: bool = True):
+        self.observation_size = observation_size
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+        self.dueling = dueling
+
+    def init(self, key) -> Dict:
+        if not self.dueling:
+            sizes = (self.observation_size, *self.hidden, self.num_actions)
+            return {"q": _init_mlp(key, sizes)}
+        kt, ka, kv = jax.random.split(key, 3)
+        trunk_sizes = (self.observation_size, *self.hidden)
+        last = self.hidden[-1]
+        return {"trunk": _init_mlp(kt, trunk_sizes),
+                "adv": _init_mlp(ka, (last, self.num_actions)),
+                "val": _init_mlp(kv, (last, 1))}
+
+    @staticmethod
+    def _relu_mlp(layers, x, final_relu: bool):
+        for i, layer in enumerate(layers):
+            x = x @ layer["w"] + layer["b"]
+            if i < len(layers) - 1 or final_relu:
+                x = jax.nn.relu(x)
+        return x
+
+    def q_values(self, params, obs) -> jnp.ndarray:
+        if not self.dueling:
+            return self._relu_mlp(params["q"], obs, final_relu=False)
+        h = self._relu_mlp(params["trunk"], obs, final_relu=True)
+        adv = self._relu_mlp(params["adv"], h, final_relu=False)
+        val = self._relu_mlp(params["val"], h, final_relu=False)
+        return val + adv - adv.mean(axis=-1, keepdims=True)
+
+    def forward_inference(self, params, obs) -> jnp.ndarray:
+        return jnp.argmax(self.q_values(params, obs), axis=-1)

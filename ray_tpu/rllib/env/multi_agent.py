@@ -1,0 +1,258 @@
+"""Multi-agent env surface: dict-keyed agents, per-agent policy mapping.
+
+Counterpart of the reference's MultiAgentEnv (reference:
+rllib/env/multi_agent_env.py — dict obs/action spaces keyed by agent id,
+per-agent reward/terminated dicts with the ``__all__`` episode flag;
+policy mapping via config.multi_agent(policies=...,
+policy_mapping_fn=...), rllib/algorithms/algorithm_config.py multi_agent()).
+
+TPU-first layout mirrors the single-agent split: the env + runner are host
+numpy programs; each POLICY is a params pytree updated by its own jitted
+learner.  The runner routes observations agent→policy with the mapping fn,
+and emits one PPO-shaped time-major batch PER POLICY — agents sharing a
+policy become extra env columns (K_policy = num_envs × agents_mapped), so
+the single-agent learner update is reused unchanged.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+class MultiAgentVectorEnv:
+    """Vectorized multi-agent env: K independent copies of an A-agent world.
+
+    Episodes are SHARED per copy (the reference's ``__all__`` semantics):
+    when a copy's episode ends, every agent in that copy resets together.
+    Per-agent terminated/truncated dicts still differ — an agent that
+    personally failed is terminated (no bootstrap), a surviving agent in an
+    ending episode is truncated (bootstrap through the cut).
+    """
+
+    agents: List[str]
+    observation_sizes: Dict[str, int]
+    num_actions: Dict[str, int]
+
+    def reset(self) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def step(self, actions: Dict[str, np.ndarray]):
+        """actions: {agent: (K,)}; returns (obs, rewards, terminated,
+        truncated, info) — each a {agent: (K, ...)} dict; info["final_obs"]
+        holds pre-reset observations (valid where an episode ended)."""
+        raise NotImplementedError
+
+
+class MultiCartPole(MultiAgentVectorEnv):
+    """A-agent cartpole: each agent balances its own pole, but the EPISODE is
+    shared — it ends when any pole falls (or at 500 steps), which gives the
+    shared-fate termination structure real multi-agent envs have while the
+    physics stays exactly CartPole-v1 (comparable returns)."""
+
+    max_episode_steps = 500
+
+    def __init__(self, num_envs: int, num_agents: int = 2, seed: int = 0):
+        from ray_tpu.rllib.env.cartpole import CartPoleVectorEnv
+
+        self.num_envs = num_envs
+        self.agents = [f"agent_{i}" for i in range(num_agents)]
+        self.observation_sizes = {a: 4 for a in self.agents}
+        self.num_actions = {a: 2 for a in self.agents}
+        self._pole = {a: CartPoleVectorEnv(num_envs, seed=seed + 131 * i)
+                      for i, a in enumerate(self.agents)}
+        self.steps = np.zeros(num_envs, np.int32)
+
+    def reset(self) -> Dict[str, np.ndarray]:
+        self.steps[:] = 0
+        return {a: p.reset() for a, p in self._pole.items()}
+
+    def step(self, actions: Dict[str, np.ndarray]):
+        obs, rewards, fell, final = {}, {}, {}, {}
+        for a in self.agents:
+            pole = self._pole[a]
+            # step WITHOUT auto-reset semantics: we manage shared episodes,
+            # so suppress the per-pole step counter's own truncation
+            pole.steps[:] = 0
+            o, r, term, _trunc, info = pole.step(actions[a])
+            obs[a] = o
+            rewards[a] = r
+            fell[a] = term
+            final[a] = info["final_obs"]
+        self.steps += 1
+        any_fell = np.zeros(self.num_envs, bool)
+        for a in self.agents:
+            any_fell |= fell[a]
+        timeout = self.steps >= self.max_episode_steps
+        done = any_fell | timeout
+        terminated = {a: fell[a] for a in self.agents}
+        truncated = {a: done & ~fell[a] for a in self.agents}
+        if done.any():
+            # shared reset: every agent's copy restarts together.  The
+            # sub-env's final_obs is already the pre-reset state for every
+            # copy (fallen or not); here only the not-personally-fallen
+            # agents of done copies still need their state re-sampled.
+            for a in self.agents:
+                pole = self._pole[a]
+                fresh = pole._sample_state(int(done.sum()))
+                pole.state[done] = fresh
+                obs[a] = pole.state.copy()
+            self.steps[done] = 0
+        info = {"final_obs": final, "done": done}
+        return obs, rewards, terminated, truncated, info
+
+
+_MA_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_multi_agent_env(name: str, creator: Callable) -> None:
+    """reference: tune.register_env with a MultiAgentEnv creator."""
+    _MA_REGISTRY[name] = creator
+
+
+def make_multi_agent_env(name: str, num_envs: int,
+                         seed: int = 0) -> MultiAgentVectorEnv:
+    if name not in _MA_REGISTRY:
+        raise ValueError(f"unknown multi-agent env {name!r}; "
+                         f"registered: {sorted(_MA_REGISTRY)}")
+    return _MA_REGISTRY[name](num_envs=num_envs, seed=seed)
+
+
+register_multi_agent_env(
+    "MultiCartPole",
+    lambda num_envs, seed=0: MultiCartPole(num_envs, num_agents=2, seed=seed))
+
+
+class MultiAgentEnvRunner:
+    """Samples PPO-shaped fragments per POLICY from a multi-agent env.
+
+    reference: rllib/env/multi_agent_env_runner.py (sample keyed by module
+    id).  Agents mapped to the same policy are concatenated as extra env
+    columns, so each policy's batch is the exact (T, K', ...) layout the
+    single-agent JaxLearner consumes — per-policy GAE included.
+    """
+
+    def __init__(self, env_name: str, num_envs: int, rollout_length: int,
+                 policy_specs: Dict[str, Dict],
+                 policy_mapping_fn: Callable[[str], str], seed: int = 0):
+        import sys
+
+        if "jax" in sys.modules:
+            import jax._src.xla_bridge as _xb
+
+            initialized = _xb.backends_are_initialized()
+        else:
+            initialized = False
+        if not initialized:
+            # pin rollout inference to CPU BEFORE the backend initializes
+            # (see EnvRunner.__init__: un-pinned runners on a TPU VM
+            # dispatch every per-step inference to the chip, ~270x slower)
+            from ray_tpu._private.platform import force_cpu_platform
+
+            force_cpu_platform(1)
+        import jax
+
+        from ray_tpu.rllib.core.rl_module import DiscretePolicyModule
+
+        self.env = make_multi_agent_env(env_name, num_envs, seed=seed)
+        self.num_envs = num_envs
+        self.rollout_length = rollout_length
+        self.policy_mapping_fn = policy_mapping_fn
+        self.modules = {pid: DiscretePolicyModule(**spec)
+                        for pid, spec in policy_specs.items()}
+        self.params: Dict[str, object] = {}
+        self._agent_policy = {a: policy_mapping_fn(a)
+                              for a in self.env.agents}
+        for a, pid in self._agent_policy.items():
+            if pid not in self.modules:
+                raise ValueError(
+                    f"agent {a!r} maps to unknown policy {pid!r}")
+        self._key = jax.random.PRNGKey(seed)
+        self._explore = {pid: jax.jit(m.forward_exploration)
+                         for pid, m in self.modules.items()}
+        self._value = {pid: jax.jit(m.value)
+                       for pid, m in self.modules.items()}
+        self.obs = self.env.reset()
+        self._ep_return = np.zeros(num_envs, np.float32)
+        self._recent_returns: collections.deque = collections.deque(maxlen=100)
+        self._lifetime_steps = 0
+
+    def sample(self, weights: Optional[Dict[str, object]] = None
+               ) -> Dict[str, Dict[str, np.ndarray]]:
+        import jax
+
+        if weights is not None:
+            self.params = weights
+        T, K = self.rollout_length, self.num_envs
+        A = self.env.agents
+        per_agent = {a: {
+            "obs": np.empty((T, K, self.env.observation_sizes[a]), np.float32),
+            "actions": np.empty((T, K), np.int32),
+            "logp": np.empty((T, K), np.float32),
+            "values": np.empty((T, K), np.float32),
+            "rewards": np.empty((T, K), np.float32),
+            "terminated": np.empty((T, K), bool),
+            "truncated": np.empty((T, K), bool),
+            "final_obs": np.empty((T, K, self.env.observation_sizes[a]),
+                                  np.float32),
+        } for a in A}
+        for t in range(T):
+            actions = {}
+            for a in A:
+                pid = self._agent_policy[a]
+                self._key, sub = jax.random.split(self._key)
+                acts, logp, values = self._explore[pid](
+                    self.params[pid], self.obs[a], sub)
+                actions[a] = np.asarray(acts)
+                per_agent[a]["obs"][t] = self.obs[a]
+                per_agent[a]["actions"][t] = actions[a]
+                per_agent[a]["logp"][t] = np.asarray(logp)
+                per_agent[a]["values"][t] = np.asarray(values)
+            obs, rewards, terminated, truncated, info = self.env.step(actions)
+            for a in A:
+                per_agent[a]["rewards"][t] = rewards[a]
+                per_agent[a]["terminated"][t] = terminated[a]
+                per_agent[a]["truncated"][t] = truncated[a]
+                per_agent[a]["final_obs"][t] = info["final_obs"][a]
+                self._ep_return += rewards[a] / len(A)
+            for i in np.nonzero(info["done"])[0]:
+                self._recent_returns.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+            self.obs = obs
+        self._lifetime_steps += T * K  # env steps, not agent-steps
+
+        # bootstrap per agent column, then group columns by policy
+        out: Dict[str, Dict[str, np.ndarray]] = {}
+        for a in A:
+            pid = self._agent_policy[a]
+            b = per_agent[a]
+            tail = np.asarray(self._value[pid](self.params[pid], self.obs[a]))
+            nv = np.concatenate([b["values"][1:], tail[None]], axis=0)
+            nv[b["terminated"]] = 0.0
+            if b["truncated"].any():
+                tr = np.nonzero(b["truncated"])
+                vf = np.asarray(self._value[pid](
+                    self.params[pid],
+                    b["final_obs"].reshape(T * K, -1))).reshape(T, K)
+                nv[tr] = vf[tr]
+            b["next_values"] = nv.astype(np.float32)
+            del b["final_obs"]
+            grp = out.setdefault(pid, {})
+            for k, v in b.items():
+                grp.setdefault(k, []).append(v)
+        return {pid: {k: np.concatenate(vs, axis=1)
+                      for k, vs in grp.items()}
+                for pid, grp in out.items()}
+
+    def get_metrics(self) -> Dict:
+        return {
+            "episode_return_mean": (float(np.mean(self._recent_returns))
+                                    if self._recent_returns else float("nan")),
+            "num_episodes": len(self._recent_returns),
+            "num_env_steps_sampled_lifetime": self._lifetime_steps,
+        }
+
+    def ping(self) -> bool:
+        return True
