@@ -181,7 +181,12 @@ class CoreWorker:
         self._put_index = 0
         self._put_lock = threading.Lock()
 
-        self._refs_lock = threading.Lock()
+        # RLock, not Lock: ActorHandle.__del__ (via remove_actor_handle)
+        # acquires this, and a GC cycle can run that finalizer on a thread
+        # ALREADY inside a _refs_lock section (observed: complete_task's
+        # discard triggered gc -> __del__ -> self-deadlock wedging the IO
+        # loop).  Reentrancy makes the finalizer path safe wherever gc runs.
+        self._refs_lock = threading.RLock()
         self._contained: Dict[ObjectID, List[ObjectRef]] = {}
         self._owned_in_plasma: set = set()
         self._actor_handle_counts: Dict[ActorID, int] = {}

@@ -198,6 +198,25 @@ def _cmd_job(args) -> int:
         client.close()
 
 
+def _cmd_up(args) -> int:
+    from ray_tpu.autoscaler.launcher import cluster_up
+
+    state = cluster_up(args.config, start_monitor=not args.no_monitor)
+    print(f"cluster {state['cluster_name']} up at {state['address']}")
+    if state.get("monitor_pid"):
+        print(f"  autoscaler monitor pid: {state['monitor_pid']}")
+    print(f"  connect with: ray_tpu.init(address=\"{state['address']}\")")
+    return 0
+
+
+def _cmd_down(args) -> int:
+    from ray_tpu.autoscaler.launcher import cluster_down
+
+    cluster_down(args.config)
+    print("cluster down")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -212,6 +231,19 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("stop", help="stop the recorded local cluster")
     p.set_defaults(fn=_cmd_stop)
+
+    p = sub.add_parser(
+        "up", help="launch a cluster from a YAML config "
+        "(reference: scripts.py:1282 `ray up`)")
+    p.add_argument("config", help="cluster YAML path")
+    p.add_argument("--no-monitor", action="store_true",
+                   help="skip the autoscaler monitor daemon")
+    p.set_defaults(fn=_cmd_up)
+
+    p = sub.add_parser("down",
+                       help="tear down a cluster launched with `up`")
+    p.add_argument("config", help="cluster YAML path")
+    p.set_defaults(fn=_cmd_down)
 
     p = sub.add_parser("status", help="cluster nodes + pending demand")
     p.add_argument("--address", default=None)

@@ -27,12 +27,14 @@ import ray_tpu
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig
 from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "deployment", "run", "delete", "shutdown", "status",
     "get_deployment_handle", "get_app_handle", "batch", "start",
     "Deployment", "Application", "AutoscalingConfig", "DeploymentConfig",
     "DeploymentHandle", "DeploymentResponse",
+    "multiplexed", "get_multiplexed_model_id",
 ]
 
 
@@ -155,7 +157,7 @@ def run(target: Application, *, name: str = "default",
         name, specs, target.deployment.name, route_prefix), timeout=120)
     handle = DeploymentHandle(name, target.deployment.name)
     if _blocking:
-        handle._get_replicas()  # wait until at least one replica serves
+        handle._target.get_replicas()  # wait until a replica serves
     return handle
 
 

@@ -7,11 +7,12 @@ controller holds the declarative app spec, and a reconcile loop drives the
 actual replica actors toward it — creating, replacing dead ones, and scaling
 counts from replica-reported ongoing-request stats.
 
-Threading note: this is a SYNC actor — its methods run on executor threads
-where blocking runtime calls (actor creation, get, kill) are legal; the
-reconcile loop is a daemon thread for the same reason.  An async design would
-deadlock: async actor methods run on the worker's IO loop, and actor creation
-blocks on that loop.
+Threading note: sync methods run on executor threads where blocking runtime
+calls (actor creation, get, kill) are legal; the reconcile loop is a daemon
+thread for the same reason.  ``listen_for_change`` is the ONE async method
+(parked listeners must cost an event, not a thread) — its presence makes
+this a high-concurrency actor, so sync methods can now run CONCURRENTLY on
+executor threads and every mutation must hold a lock.
 """
 
 from __future__ import annotations
@@ -44,6 +45,71 @@ class _DeploymentState:
         self.last_health_check = 0.0
 
 
+class _LongPollHost:
+    """Versioned-key push channel (reference:
+    serve/_private/long_poll.py LongPollHost:93 — listen_for_change blocks
+    until any watched key moves past the client's snapshot version).
+
+    Publishers run on controller executor/reconcile THREADS; listeners park
+    on the worker's IO loop (async actor method), so wakeups cross via
+    ``loop.call_soon_threadsafe``.
+    """
+
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+        self._versions: Dict[str, int] = {}
+        self._waiters: Dict[int, tuple] = {}  # id -> (loop, event, keys)
+        self._next_waiter = 0
+        self._lock = threading.Lock()
+
+    def publish(self, key: str, value: Any) -> None:
+        """Bump + wake only on actual change (idempotent republish from the
+        reconcile loop must not spin listeners)."""
+        import asyncio  # noqa: F401  (documenting the loop dependency)
+
+        with self._lock:
+            if key in self._versions and self._values.get(key) == value:
+                return
+            self._values[key] = value
+            self._versions[key] = self._versions.get(key, 0) + 1
+            wake = [(loop, ev) for loop, ev, keys in self._waiters.values()
+                    if key in keys]
+        for loop, ev in wake:
+            loop.call_soon_threadsafe(ev.set)
+
+    async def listen(self, snapshot: Dict[str, int], timeout_s: float):
+        """Return {key: {"version": v, "value": ...}} for every watched key
+        newer than the client's snapshot; block (on the IO loop) until one
+        changes or the timeout passes ({} -> client re-issues)."""
+        import asyncio
+
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                out = {k: {"version": self._versions[k],
+                           "value": self._values[k]}
+                       for k in snapshot
+                       if self._versions.get(k, 0) > snapshot[k]}
+                if out:
+                    return out
+                loop = asyncio.get_event_loop()
+                ev = asyncio.Event()
+                wid = self._next_waiter
+                self._next_waiter += 1
+                self._waiters[wid] = (loop, ev, set(snapshot))
+            try:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {}
+                try:
+                    await asyncio.wait_for(ev.wait(), remaining)
+                except asyncio.TimeoutError:
+                    return {}
+            finally:
+                with self._lock:
+                    self._waiters.pop(wid, None)
+
+
 @ray_tpu.remote(num_cpus=0)
 class ServeController:
     def __init__(self):
@@ -54,6 +120,11 @@ class ServeController:
         self._proxy_port: Optional[int] = None
         self._shutting_down = False
         self._lock = threading.RLock()
+        self._lp = _LongPollHost()
+        # concurrent serve.start()/run() calls must not double-bind a proxy
+        self._proxy_lock = threading.Lock()
+        # (app, deployment) -> {replica_actor_id_hex: [model ids]}
+        self._multiplex: Dict[tuple, Dict[str, list]] = {}
         # Serializes whole reconcile passes: deploy/delete call _reconcile_once
         # from the controller executor thread while the daemon loop runs its
         # own — concurrent passes would double-provision the same deficit.
@@ -89,6 +160,7 @@ class ServeController:
                 self._routes[route_prefix] = name
         for d in to_stop:
             self._stop_replicas(d)
+        self._lp.publish("routes", self.get_routes())
         self._reconcile_once()
         return True
 
@@ -100,6 +172,8 @@ class ServeController:
         if app:
             for d in app.values():
                 self._stop_replicas(d)
+                self._lp.publish(f"replicas::{name}/{d.name}", [])
+        self._lp.publish("routes", self.get_routes())
         return True
 
     def shutdown(self):
@@ -112,6 +186,34 @@ class ServeController:
             except Exception:
                 pass
             self._proxy = None
+        return True
+
+    # ----------------------------------------------------------- long poll
+    async def listen_for_change(self, snapshot: Dict[str, int],
+                                timeout_s: float = 30.0):
+        """Push channel for routers/handles (reference: long_poll.py
+        LongPollHost.listen_for_change).  Runs as an ASYNC actor method so a
+        parked listener costs an event, not an executor thread."""
+        return await self._lp.listen(snapshot, timeout_s)
+
+    def record_multiplexed_models(self, app: str, deployment: str,
+                                  replica_id: str, model_ids: List[str],
+                                  seq: int = 0):
+        """Replica -> controller report of its loaded model set; fanned out
+        to routers via long-poll (reference: serve/multiplex.py model
+        registry + RunningReplicaInfo.multiplexed_model_ids).  ``seq`` is
+        the replica's report counter — reports ride independent
+        fire-and-forget sends, so an out-of-order stale snapshot must lose
+        to the newer one already applied."""
+        key = (app, deployment)
+        with self._lock:
+            m = self._multiplex.setdefault(key, {})
+            prev_seq, _ = m.get(replica_id, (0, None))
+            if seq and seq <= prev_seq:
+                return True
+            m[replica_id] = (seq, list(model_ids))
+            value = {rid: list(models) for rid, (s_, models) in m.items()}
+        self._lp.publish(f"multiplex::{app}/{deployment}", value)
         return True
 
     # ------------------------------------------------------------- queries
@@ -136,6 +238,10 @@ class ServeController:
 
     def ensure_proxy(self, host: str, port: int,
                      grpc_port=None) -> int:
+        with self._proxy_lock:
+            return self._ensure_proxy_locked(host, port, grpc_port)
+
+    def _ensure_proxy_locked(self, host, port, grpc_port) -> int:
         if self._proxy is None:
             from ray_tpu.serve._proxy import ProxyActor
 
@@ -188,13 +294,31 @@ class ServeController:
                             surplus.append(r)
                 for victim in surplus:
                     self._stop_one(victim)
+                # push the (possibly) new replica set; publish() no-ops when
+                # nothing changed, so the steady-state loop stays silent
+                with self._lock:
+                    live = list(d.replicas)
+                    live_ids = {r._actor_id.hex() for r in live}
+                    m = self._multiplex.get((app, d.name))
+                    mux_value = None
+                    if m:
+                        stale = set(m) - live_ids
+                        for rid in stale:
+                            del m[rid]
+                        if stale:
+                            mux_value = {rid: list(models)
+                                         for rid, (s_, models) in m.items()}
+                if mux_value is not None:
+                    self._lp.publish(f"multiplex::{app}/{d.name}", mux_value)
+                self._lp.publish(f"replicas::{app}/{d.name}", live)
 
     def _start_replica(self, app: str, d: _DeploymentState):
         opts = dict(d.config.ray_actor_options or {})
         opts.setdefault("num_cpus", 0)
         return ServeReplica.options(**opts).remote(
             d.spec["serialized_cls"], d.spec["init_args"],
-            d.spec["init_kwargs"], d.config.max_ongoing_requests)
+            d.spec["init_kwargs"], d.config.max_ongoing_requests,
+            app_name=app, deployment_name=d.name)
 
     def _health_check(self, d: _DeploymentState):
         now = time.monotonic()

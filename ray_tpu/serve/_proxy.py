@@ -35,6 +35,11 @@ class ProxyActor:
         self._grpc_server = None
         self._site = None
         self._handles: Dict[str, object] = {}
+        # routes cache fed by the controller's long-poll channel: route
+        # changes arrive as pushes instead of a control-plane RPC per
+        # request (reference: proxy's LongPollClient on route_table)
+        self._routes: Optional[Dict[str, str]] = None
+        self._routes_listener = None
 
     async def ready(self) -> int:
         """Start the aiohttp server (and the gRPC server when configured);
@@ -144,18 +149,63 @@ class ProxyActor:
             return web.Response(body=out)
         return web.Response(text=str(out))
 
+    def _ensure_routes_listener(self):
+        import threading
+
+        if self._routes_listener is not None \
+                and self._routes_listener.is_alive():
+            return
+        self._routes_listener = threading.Thread(
+            target=self._routes_listen_loop, daemon=True,
+            name="serve-proxy-routes")
+        self._routes_listener.start()
+
+    def _routes_listen_loop(self):
+        import time as _time
+
+        from ray_tpu.serve._controller import get_controller
+
+        version = 0
+        while True:
+            try:
+                out = ray_tpu.get(get_controller().listen_for_change.remote(
+                    {"routes": version}, 30.0), timeout=45)
+            except Exception:
+                _time.sleep(1.0)
+                continue
+            entry = (out or {}).get("routes")
+            if entry:
+                version = entry["version"]
+                self._routes = dict(entry["value"])
+
     def _route_and_call(self, path: str, body):
         from ray_tpu.serve._controller import get_controller
 
-        ctrl = get_controller()
-        routes = ray_tpu.get(ctrl.get_routes.remote(), timeout=30)
+        self._ensure_routes_listener()
+        routes = self._routes
+        if routes is None:  # bootstrap before the first push lands
+            ctrl = get_controller()
+            routes = ray_tpu.get(ctrl.get_routes.remote(), timeout=30)
+            self._routes = routes
         # longest matching prefix wins (reference: proxy route resolution)
-        best = None
-        for prefix, app_name in routes.items():
-            if path == prefix or path.startswith(prefix.rstrip("/") + "/") \
-                    or prefix == "/":
-                if best is None or len(prefix) > len(best[0]):
-                    best = (prefix, app_name)
+        def match(routes):
+            best = None
+            for prefix, app_name in routes.items():
+                if path == prefix \
+                        or path.startswith(prefix.rstrip("/") + "/") \
+                        or prefix == "/":
+                    if best is None or len(prefix) > len(best[0]):
+                        best = (prefix, app_name)
+            return best
+
+        best = match(routes)
+        if best is None:
+            # a request can race the deploy's push: confirm the miss
+            # against the controller before 404ing
+            routes = ray_tpu.get(
+                get_controller().get_routes.remote(), timeout=30)
+            self._routes = routes
+            best = match(routes)
         if best is None:
             raise LookupError(path)
         return self._call_app(best[1], body)

@@ -20,17 +20,56 @@ import ray_tpu
 @ray_tpu.remote
 class ServeReplica:
     def __init__(self, serialized_cls: bytes, init_args, init_kwargs,
-                 max_ongoing_requests: int):
+                 max_ongoing_requests: int, app_name: str = "",
+                 deployment_name: str = ""):
         import cloudpickle
 
         cls = cloudpickle.loads(serialized_cls)
         self._user = cls(*init_args, **(init_kwargs or {}))
         self._max_ongoing = max_ongoing_requests
+        self._app = app_name
+        self._deployment = deployment_name
         self._ongoing = 0
         self._total = 0
         self._started_at = time.time()
+        # multiplex: loader caches report loaded-model sets through this
+        # hook; fire-and-forget to the controller, fanned to routers via
+        # long-poll (reference: replica multiplexed_model_ids reporting)
+        from ray_tpu.serve import multiplex as _mux
 
-    async def handle_request(self, method: str, args, kwargs) -> Any:
+        self._mux = _mux
+        self._mux_seq = 0
+        self._mux_seq_lock = __import__("threading").Lock()
+        _mux._set_report_hook(self._report_models)
+
+    def _report_models(self, model_ids):
+        # Runs on the replica's IO loop (model-cache finally): the controller
+        # LOOKUP is a blocking runtime call and would wedge the loop (pings
+        # stop dispatching, health checks kill the replica) — do the whole
+        # report on a thread.  Each report carries a sequence number: the
+        # threads' fire-and-forget sends can arrive out of order, and a
+        # stale earlier snapshot must not overwrite a newer one.
+        import threading
+
+        with self._mux_seq_lock:
+            self._mux_seq += 1
+            seq = self._mux_seq
+
+        def do():
+            try:
+                from ray_tpu.serve._controller import get_controller
+
+                rid = ray_tpu.get_runtime_context().get_actor_id()
+                get_controller().record_multiplexed_models.remote(
+                    self._app, self._deployment, rid, model_ids, seq)
+            except Exception:
+                pass
+
+        threading.Thread(target=do, daemon=True,
+                         name="serve-mux-report").start()
+
+    async def handle_request(self, method: str, args, kwargs,
+                             multiplexed_model_id: str = "") -> Any:
         """Run one request through the user callable.  The handle-level router
         already respects max_ongoing_requests; the replica enforces it again
         as a backstop (reference: replica backpressure).
@@ -43,6 +82,7 @@ class ServeReplica:
             await asyncio.sleep(0.005)
         self._ongoing += 1
         self._total += 1
+        token = self._mux._model_id_ctx.set(multiplexed_model_id)
         try:
             call = getattr(self._user, method, None)
             if call is None:
@@ -53,12 +93,14 @@ class ServeReplica:
                 out = call(*args, **kwargs)
             else:
                 loop = asyncio.get_event_loop()
+                ctx = __import__("contextvars").copy_context()
                 out = await loop.run_in_executor(
-                    None, lambda: call(*args, **kwargs))
+                    None, lambda: ctx.run(call, *args, **kwargs))
             if inspect.isawaitable(out):
                 out = await out
             return out
         finally:
+            self._mux._model_id_ctx.reset(token)
             self._ongoing -= 1
 
     async def _resolve_refs(self, args, kwargs):

@@ -230,3 +230,148 @@ def test_grpc_ingress_shares_router(serve_instance):
         assert e.code() == grpc.StatusCode.NOT_FOUND
     ch.close()
     serve.delete("echoapp")
+
+
+def test_long_poll_pushes_replica_set_without_poll_tick(serve_instance):
+    """A redeploy's new replica set reaches an existing handle by PUSH:
+    visible well inside the old 2 s poll period (reference:
+    _private/long_poll.py LongPollHost/Client)."""
+
+    @serve.deployment(num_replicas=1)
+    class V:
+        def __call__(self, _):
+            return "v1"
+
+    serve.run(V.bind(), name="lp", route_prefix="/lp")
+    h = serve.get_app_handle("lp")
+    assert h.remote(None).result(60) == "v1"
+    old_ids = {r._actor_id for r in h._target.replicas}
+    assert old_ids, "listener should have populated the replica cache"
+
+    @serve.deployment(name="V", num_replicas=1)
+    class V2:
+        def __call__(self, _):
+            return "v2"
+
+    serve.run(V2.bind(), name="lp", route_prefix="/lp")
+    # the push must swap the handle's cached replicas promptly — no result()
+    # call in between, so only the listener can have updated the cache
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        with h._target.lock:
+            cur = {r._actor_id for r in h._target.replicas}
+        if cur and cur != old_ids:
+            break
+        time.sleep(0.05)
+    assert cur and cur != old_ids, "replica-set push never arrived"
+    assert h.remote(None).result(60) == "v2"
+    serve.delete("lp")
+
+
+def test_multiplexed_lru_and_router_affinity(serve_instance):
+    """The router steers repeat requests for a model to a replica that
+    already holds it — loaded exactly once cluster-wide once the multiplex
+    map fans out (reference: serve/multiplex.py + pow-2 multiplexed
+    candidate ranking).  Capacity >= model count here so routing is the only
+    variable; LRU/eviction-order semantics are covered deterministically in
+    test_model_cache_lru_semantics."""
+
+    @serve.deployment(num_replicas=2, max_ongoing_requests=4)
+    class Adapters:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=3)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return f"weights-{model_id}"
+
+        async def __call__(self, _):
+            mid = serve.get_multiplexed_model_id()
+            model = await self.get_model(mid)
+            import os
+
+            return {"model": model, "pid": os.getpid(),
+                    "loads": list(self.loads)}
+
+    serve.run(Adapters.bind(), name="mux", route_prefix="/mux")
+    h = serve.get_app_handle("mux")
+
+    for m in ("m1", "m2", "m3"):
+        out = h.options(multiplexed_model_id=m).remote(None).result(60)
+        assert out["model"] == f"weights-{m}"
+
+    # give the multiplex map a beat to fan out to the router
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        with h._target.lock:
+            mm = dict(h._target.model_map)
+        if sum(len(v) for v in mm.values()) >= 3:
+            break
+        time.sleep(0.1)
+    assert sum(len(v) for v in mm.values()) >= 3, mm
+
+    # repeat requests: with the affinity map live they hit a replica that
+    # ALREADY holds the model — never a second load of the same id on the
+    # serving replica
+    for _ in range(3):
+        for m in ("m1", "m2", "m3"):
+            out = h.options(multiplexed_model_id=m).remote(None).result(60)
+            assert out["model"] == f"weights-{m}"
+            assert out["loads"].count(m) == 1, (m, out["loads"])
+    serve.delete("mux")
+
+
+def test_model_cache_lru_semantics():
+    """_ModelCache unit semantics, deterministic: LRU eviction order,
+    evict-BEFORE-load (HBM bound), single-flight concurrent cold loads
+    (reference: serve/multiplex.py _ModelMultiplexWrapper)."""
+    import asyncio
+
+    from ray_tpu.serve.multiplex import _ModelCache
+
+    events = []
+
+    async def loader(owner, model_id):
+        events.append(("load", model_id))
+        await asyncio.sleep(0.01)
+        return f"w-{model_id}"
+
+    async def main():
+        cache = _ModelCache(loader, max_models=2)
+        assert await cache.get(None, "a") == "w-a"
+        assert await cache.get(None, "b") == "w-b"
+        # touch a -> b is now the LRU victim
+        await cache.get(None, "a")
+        # at capacity: the victim must leave BEFORE c loads
+        await cache.get(None, "c")
+        assert list(cache.models) == ["a", "c"]
+        assert events == [("load", "a"), ("load", "b"), ("load", "c")]
+        # b was evicted: loading it again is a real load, evicting a (LRU)
+        await cache.get(None, "b")
+        assert list(cache.models) == ["c", "b"]
+        # single-flight: concurrent cold requests -> ONE load
+        events.clear()
+        outs = await asyncio.gather(*[cache.get(None, "z")
+                                      for _ in range(5)])
+        assert outs == ["w-z"] * 5
+        assert events == [("load", "z")]
+
+    asyncio.run(main())
+
+
+def test_multiplexed_requires_model_id(serve_instance):
+    @serve.deployment(num_replicas=1)
+    class M:
+        @serve.multiplexed(max_num_models_per_replica=1)
+        async def get_model(self, model_id):
+            return model_id
+
+        async def __call__(self, _):
+            return await self.get_model()  # no id anywhere -> error
+
+    serve.run(M.bind(), name="muxerr", route_prefix="/muxerr")
+    h = serve.get_app_handle("muxerr")
+    with pytest.raises(Exception, match="no model id"):
+        h.remote(None).result(60)
+    serve.delete("muxerr")
