@@ -1590,7 +1590,7 @@ class CoreWorker:
         (reference: CompiledDAG's actor execution loop,
         dag/compiled_dag_node.py:480)."""
         from ray_tpu.dag.compiled import DagError
-        from ray_tpu.experimental.channel import ChannelClosed, ShmChannel
+        from ray_tpu.experimental.channel import ChannelClosed, open_channel
 
         opened: list = []
         outs: list = []
@@ -1601,12 +1601,12 @@ class CoreWorker:
             srcs: list = []
             for kind, v in cfg["args"]:
                 if kind == "ch":
-                    ch = ShmChannel(v)
+                    ch = open_channel(v, "r")
                     opened.append(ch)
                     srcs.append(ch)
                 else:
                     srcs.append((v,))  # constant, pre-wrapped
-            outs = [ShmChannel(n) for n in cfg["out"]]
+            outs = [open_channel(n, "w") for n in cfg["out"]]
             opened.extend(outs)
             kwargs = cfg.get("kwargs") or {}
             while True:

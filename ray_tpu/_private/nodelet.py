@@ -382,6 +382,12 @@ class Nodelet:
                 "object_store_bytes_used", "plasma bytes in use")
             self._m_store_objects = M.Gauge(
                 "object_store_objects", "local objects")
+            self._m_store_capacity = M.Gauge(
+                "object_store_capacity_bytes", "plasma capacity")
+            self._m_mem_used = M.Gauge(
+                "node_mem_used_bytes", "host memory in use")
+            self._m_mem_total = M.Gauge(
+                "node_mem_total_bytes", "host memory total")
         nid = self.node_id.hex()[:12]
         for k, v in self.resources_available.items():
             self._m_resources.set(v, {"node": nid, "resource": k})
@@ -394,6 +400,13 @@ class Nodelet:
         self._m_store_bytes.set(st.get("used", 0), {"node": nid})
         self._m_store_objects.set(st.get("num_objects", len(self.store.objects)),
                                   {"node": nid})
+        self._m_store_capacity.set(self.store.capacity, {"node": nid})
+        from ray_tpu._private.memory_monitor import _read_meminfo
+
+        mem = _read_meminfo()
+        if mem is not None:
+            self._m_mem_used.set(mem[0], {"node": nid})
+            self._m_mem_total.set(mem[1], {"node": nid})
 
     async def rpc_metrics_push(self, conn, msg):
         """A worker pushes its metric snapshot for this node's scrape
@@ -403,6 +416,47 @@ class Nodelet:
 
     async def rpc_get_metrics_text(self, conn, msg):
         return self.metrics_registry.prometheus_text()
+
+    # ------------------------------------------------------------- log files
+    def _log_dir(self) -> str:
+        return os.path.join(self.session_dir, "logs")
+
+    async def rpc_list_log_files(self, conn, msg):
+        """Names + sizes of this node's log files (worker stdout/stderr,
+        nodelet/gcs logs) — the `ray logs` surface (reference:
+        python/ray/_private/log_monitor.py; dashboard log module)."""
+        log_dir = self._log_dir()
+        out = []
+        try:
+            names = sorted(os.listdir(log_dir))
+        except FileNotFoundError:
+            return out
+        for name in names:
+            path = os.path.join(log_dir, name)
+            try:
+                if not os.path.isfile(path):
+                    continue
+                st = os.stat(path)
+            except FileNotFoundError:
+                continue  # rotated/unlinked between listdir and stat
+            out.append({"name": name, "size": st.st_size,
+                        "mtime": st.st_mtime})
+        return out
+
+    async def rpc_tail_log(self, conn, msg):
+        """Last ``nbytes`` of one log file.  The name is sanitized to a
+        basename inside the session logs dir — no path traversal."""
+        name = os.path.basename(msg["name"])
+        path = os.path.join(self._log_dir(), name)
+        nbytes = min(int(msg.get("nbytes", 64 * 1024)), 4 * 1024 * 1024)
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - nbytes))
+                return f.read()
+        except FileNotFoundError:
+            return None
 
     async def _flush_dir_loop(self):
         while True:

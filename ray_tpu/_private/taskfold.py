@@ -1,0 +1,47 @@
+"""Fold raw task lifecycle events into one row per (task, attempt).
+
+Shared by the driver-side state API (``ray_tpu.util.state.list_tasks``) and
+the dashboard (a pure GCS RPC client that must not import the worker
+module) — one copy so the two surfaces can never disagree on folding
+semantics (reference: the GcsTaskManager event aggregation both the state
+API and dashboard read, src/ray/gcs/gcs_server/gcs_task_manager.cc).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+# Driver and workers flush on independent timers, so GCS arrival order is
+# not event order — fold by emission timestamp (rank breaks exact ties).
+_RANK = {"SUBMITTED": 0, "RUNNING": 1, "FAILED": 2, "FINISHED": 2}
+
+
+def fold_task_events(events, limit: int = 1000,
+                     job_id: Optional[str] = None,
+                     name: Optional[str] = None) -> List[Dict[str, Any]]:
+    """One row per (task, attempt): latest state + per-state timestamps."""
+    rows: Dict[tuple, Dict[str, Any]] = {}
+    for ev in sorted(events, key=lambda e: (e["ts"], _RANK.get(e["state"], 1))):
+        if job_id is not None and ev.get("job_id") != job_id:
+            continue
+        if name is not None and ev.get("name") != name:
+            continue
+        key = (ev["task_id"], ev.get("attempt", 0))
+        row = rows.setdefault(key, {
+            "task_id": ev["task_id"],
+            "attempt": ev.get("attempt", 0),
+            "name": ev.get("name"),
+            "type": ev.get("type"),
+            "job_id": ev.get("job_id"),
+            "actor_id": ev.get("actor_id"),
+            "trace_id": ev.get("trace_id"),
+            "span_id": ev.get("span_id"),
+            "parent_span_id": ev.get("parent_span_id"),
+            "state_ts": {},
+        })
+        row["state_ts"][ev["state"]] = ev["ts"]
+        row["state"] = ev["state"]
+        for k in ("node_id", "worker_id", "pid", "error"):
+            if ev.get(k) is not None:
+                row[k] = ev[k]
+    return list(rows.values())[-limit:]

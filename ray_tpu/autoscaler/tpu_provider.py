@@ -347,7 +347,36 @@ class TPUNodeProvider(NodeProvider):
         return created
 
     # ------------------------------------------------------------ listing
+    def _reap_released_slices(self) -> None:
+        """Reconciliation sweep: delete any slice whose every host is
+        released.  ``terminate_node`` deletes on the last release already;
+        this makes the invariant self-healing — if that deletion is ever
+        missed (exception between release and delete, crash, interleaving),
+        the next listing pass fixes it instead of leaking an allocated
+        slice forever."""
+        with self._lock:
+            by_slice: Dict[str, List[dict]] = {}
+            for h in self._hosts.values():
+                by_slice.setdefault(h["slice"], []).append(h)
+            doomed = [s for s, hosts in by_slice.items()
+                      if all(x["released"] for x in hosts)]
+        for s in doomed:
+            logger.warning("slice %s fully released but still allocated; "
+                           "reconciliation sweep deleting it", s)
+            try:
+                self.api.delete_slice(s)  # idempotent at the api layer
+            except Exception:
+                # keep the host entries: the next sweep retries the delete
+                logger.exception("sweep delete of %s failed; will retry", s)
+                continue
+            with self._lock:
+                for hid in [hid for hid, x in self._hosts.items()
+                            if x["slice"] == s]:
+                    del self._hosts[hid]
+                self._slice_pod.pop(s, None)
+
     def non_terminated_nodes(self, tag_filters: Dict[str, str]) -> List[str]:
+        self._reap_released_slices()
         with self._lock:
             items = list(self._hosts.items())
         # one control-plane query per SLICE, not per host (a gcloud describe

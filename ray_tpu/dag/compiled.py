@@ -13,10 +13,15 @@ compile, a hop costs one pickle + one memcpy + one ring-counter publish;
 no lease, no RPC frame, no event loop.
 
 Restrictions (mirroring the reference's v1): every non-input node is an
-actor-method call, one loop per actor, single output node, channels are
-single-node (the compiled graph's actors must share the host with the
-driver — TPU pods gang-schedule exactly this way; cross-host edges stay on
-the object-plane path).
+actor-method call, one loop per actor, single output node.
+
+Edges are node-aware: when both endpoints live on the driver's node the edge
+is an shm ring; an edge that crosses nodes falls back to a TCP channel with
+the same depth-bounded SPSC semantics (``experimental.channel.TcpChannel``,
+rendezvous via GCS KV) — so a gang-scheduled per-host pipeline compiles and
+runs without driver co-location (reference analogue: the remote-reader path
+of shared_memory_channel.py; the NCCL device channel,
+torch_tensor_nccl_channel.py:191, is the future device-plane upgrade).
 """
 
 from __future__ import annotations
@@ -25,7 +30,8 @@ import pickle
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.dag import ClassMethodNode, DAGNode, InputNode
-from ray_tpu.experimental.channel import ChannelClosed, ShmChannel
+from ray_tpu.experimental.channel import (ChannelClosed, ShmChannel,
+                                          TcpChannel)
 
 CHANNEL_LOOP_METHOD = "__ray_tpu_channel_loop__"
 
@@ -75,6 +81,9 @@ class CompiledDAG:
         self._input_channels: List[ShmChannel] = []
         self._out_channel: Optional[ShmChannel] = None
         self._loop_refs = []
+        import uuid
+
+        self._dag_uid = uuid.uuid4().hex[:12]  # KV keys must not collide
         self._seq = 0
         self._drained = -1
         self._results: Dict[int, Any] = {}
@@ -131,28 +140,73 @@ class CompiledDAG:
                     "input; every node needs at least one DAG-valued arg")
         self._actor_ids = actors
 
-        # one channel per edge; producers write every out-edge
-        def new_channel() -> ShmChannel:
-            ch = ShmChannel(create=True, slot_size=self._max_buf,
-                            depth=self._depth)
-            self._channels.append(ch)
-            return ch
+        # Edge placement: shm ring when producer, consumer AND driver share a
+        # node; TCP channel (KV-rendezvous'd by edge id) when the edge leaves
+        # the driver's host.  Node lookup blocks until each actor is alive —
+        # its placement is undefined earlier.
+        from ray_tpu._private.worker import require_core
 
-        # node -> list of (consumer position) out channels
-        out_edges: Dict[int, List[ShmChannel]] = {id(n): [] for n in order}
-        input_edges: List[ShmChannel] = []
+        core = require_core()
+        if core.node_id is not None:
+            driver_node = core.node_id.binary()
+        else:
+            # drivers carry no node id; their locality is the nodelet they
+            # are attached to
+            info = core.io.run(core.nodelet_conn.call("node_info", None))
+            driver_node = info["node_id"]
+        actor_node: Dict[Any, bytes] = {}
+        for n in order:
+            aid = n._actor_method._handle._actor_id
+            if aid in actor_node:
+                continue
+            info = core.gcs_call_sync(
+                "get_actor_info",
+                {"actor_id": aid.binary(), "wait_alive": True, "timeout": 60})
+            if info is None or info.get("node_id") is None:
+                raise RuntimeError(
+                    f"cannot compile: actor {aid.hex()[:8]} has no node "
+                    "placement (dead or never scheduled)")
+            actor_node[aid] = info["node_id"]
+
+        def node_of(dag_node) -> bytes:
+            if isinstance(dag_node, InputNode):
+                return driver_node
+            return actor_node[dag_node._actor_method._handle._actor_id]
+
+        self._edge_seq = 0
+        self._edge_kinds: List[str] = []  # compile summary ("shm"/"tcp")
+
+        def new_edge(src_node: bytes, dst_node: bytes):
+            """Returns (descriptor, driver_endpoint_factory)."""
+            if src_node == dst_node == driver_node:
+                ch = ShmChannel(create=True, slot_size=self._max_buf,
+                                depth=self._depth)
+                self._channels.append(ch)
+                self._edge_kinds.append("shm")
+                return ch.name, ch
+            self._edge_seq += 1
+            cid = f"dag-{self._dag_uid}-{self._edge_seq}"
+            self._edge_kinds.append("tcp")
+            return ("tcp", cid, self._depth), None
+
+        # node -> list of out-edge descriptors
+        out_edges: Dict[int, List[Any]] = {id(n): [] for n in order}
+        input_edges: List[Any] = []   # driver-side writer endpoints
         node_cfg: Dict[int, dict] = {}
         for n in order:
             arg_sources = []
             for a in n._bound_args:
                 if isinstance(a, InputNode):
-                    ch = new_channel()
+                    desc, ch = new_edge(driver_node, node_of(n))
+                    if ch is None:
+                        ch = TcpChannel(desc[1], role="w", depth=self._depth)
+                        self._channels.append(ch)
                     input_edges.append(ch)
-                    arg_sources.append(("ch", ch.name))
+                    arg_sources.append(("ch", desc))
                 elif isinstance(a, ClassMethodNode):
-                    ch = new_channel()
-                    out_edges[id(a)].append(ch)
-                    arg_sources.append(("ch", ch.name))
+                    desc, _ = new_edge(node_of(a), node_of(n))
+                    out_edges[id(a)].append(desc)
+                    arg_sources.append(("ch", desc))
                 else:
                     arg_sources.append(("const", a))
             if n._bound_kwargs and any(
@@ -165,9 +219,10 @@ class CompiledDAG:
                 "kwargs": dict(n._bound_kwargs),
             }
         # the output node feeds the driver
-        final = new_channel()
-        out_edges[id(self._output)].append(final)
-        self._out_channel = final
+        final_desc, final_ch = new_edge(node_of(self._output), driver_node)
+        out_edges[id(self._output)].append(final_desc)
+        self._final_desc = final_desc
+        self._out_channel = final_ch  # None for tcp: opened after loops start
         self._input_channels = input_edges
 
         # start one loop per actor (a plain actor task that holds the actor
@@ -176,7 +231,7 @@ class CompiledDAG:
 
         for n in order:
             cfg = node_cfg[id(n)]
-            cfg["out"] = [ch.name for ch in out_edges[id(n)]]
+            cfg["out"] = list(out_edges[id(n)])
             # reserved method: handled by the worker runtime, so it is not
             # in the user class's method table
             loop_method = ActorMethod(n._actor_method._handle,
@@ -190,6 +245,10 @@ class CompiledDAG:
         if self._torn_down:
             raise RuntimeError("compiled DAG was torn down")
         payload = pickle.dumps(value, protocol=5)
+        # Connect the (possibly TCP) output edge NOW: a driver that executes
+        # and then delays its first get() past the producer's accept timeout
+        # would otherwise kill the edge while the result waits to be written.
+        self._ensure_out_channel()
         # Wait for room on EVERY input channel before writing any: a partial
         # write followed by a timeout would desynchronize multi-input DAGs
         # for all later executes.
@@ -201,9 +260,21 @@ class CompiledDAG:
         self._seq += 1
         return ref
 
+    def _ensure_out_channel(self):
+        """The final edge's driver endpoint: eager for shm; for a tcp edge
+        the producer actor registers the rendezvous when its loop starts, so
+        the driver connects lazily here (first result fetch)."""
+        if self._out_channel is None:
+            ch = TcpChannel(self._final_desc[1], role="r",
+                            depth=self._depth)
+            self._channels.append(ch)
+            self._out_channel = ch
+        return self._out_channel
+
     def _result_for(self, seq: int, timeout: Optional[float]) -> Any:
         """Results arrive in execute order (the graph is static): read
         forward, buffering values for refs fetched out of order."""
+        self._ensure_out_channel()
         if seq <= self._drained and seq not in self._results:
             raise RuntimeError(
                 f"result for execute #{seq} was already consumed")

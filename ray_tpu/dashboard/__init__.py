@@ -1,89 +1,215 @@
-"""Minimal cluster dashboard: REST JSON + a single-page HTML view.
+"""Cluster dashboard: REST JSON + a single-page HTML view.
 
 Reference: python/ray/dashboard/ (aiohttp head process + modules; React
-client).  Condensed to the load-bearing surface: one aiohttp app serving
+client).  Server-rendered equivalent of the load-bearing modules: one
+aiohttp app serving
 
-    GET /            — self-contained HTML overview (auto-refreshing)
-    GET /api/nodes   — node table (resources, liveness, metrics addr)
-    GET /api/actors  — actor table
-    GET /api/jobs    — submitted jobs
+    GET /                   — self-contained HTML overview (auto-refreshing)
+    GET /api/nodes          — node table (resources, liveness, metrics addr)
+    GET /api/node_metrics   — per-node utilization parsed from each nodelet's
+                              Prometheus registry (reference:
+                              dashboard/modules/reporter/reporter_agent.py)
+    GET /api/actors         — actor table (node/pid/state/restarts drill-down)
+    GET /api/jobs           — submitted jobs
     GET /api/cluster_status — autoscaler view (utilization + demand)
-    GET /api/tasks   — recent task events (state API passthrough)
+    GET /api/tasks          — folded task table (one row per task attempt,
+                              latest state + per-state timestamps; reference:
+                              dashboard task table from GcsTaskManager)
+    GET /api/task_summary   — {name: {state: count}}
+    GET /api/logs           — log files on a node   (?node_id=...)
+    GET /api/log            — tail one log file     (?node_id=...&name=...)
 
-Start it with ``python -m ray_tpu.dashboard --address HOST:PORT`` or
-``ray_tpu.dashboard.run(address)``; it is a pure CLIENT of the GCS RPC port,
-so it can run anywhere that can reach the cluster.
+Start with ``python -m ray_tpu.dashboard --address HOST:PORT`` or
+``ray_tpu.dashboard.run(address)``; it is a pure CLIENT of the GCS RPC port
+(plus direct nodelet RPCs for metrics/logs), so it can run anywhere that can
+reach the cluster.
 """
 
 from __future__ import annotations
 
-import json
-from typing import Optional, Tuple
+from typing import Dict, Tuple
+
+# one fold implementation shared with util.state (taskfold is dependency-
+# free; the dashboard still never imports the driver-side worker module)
+from ray_tpu._private.taskfold import fold_task_events as _fold_tasks
 
 _PAGE = """<!DOCTYPE html>
 <html><head><title>ray_tpu dashboard</title>
-<meta http-equiv="refresh" content="5">
 <style>
- body { font-family: ui-monospace, monospace; margin: 2rem; }
- table { border-collapse: collapse; margin-bottom: 1.5rem; }
- th, td { border: 1px solid #ccc; padding: 4px 10px; text-align: left; }
- th { background: #f0f0f0; }
- h2 { margin-bottom: .3rem; }
+ body { font-family: ui-monospace, SFMono-Regular, Menlo, monospace;
+        margin: 1.5rem; background: #fafafa; color: #1a1a1a; }
+ table { border-collapse: collapse; margin: .4rem 0 1.2rem; background: #fff; }
+ th, td { border: 1px solid #d8d8d8; padding: 3px 9px; text-align: left;
+          font-size: 13px; }
+ th { background: #eef1f4; position: sticky; top: 0; }
+ h1 { font-size: 20px; } h2 { font-size: 15px; margin: 1rem 0 .2rem; }
+ .bar { display: inline-block; height: 9px; background: #4a7fd4;
+        vertical-align: middle; border-radius: 2px; }
+ .barbox { display: inline-block; width: 90px; background: #e3e6ea;
+           border-radius: 2px; margin-right: 6px; }
+ .dead { color: #b00; } .alive { color: #070; }
+ .state-FINISHED { color: #070; } .state-FAILED { color: #b00; }
+ .state-RUNNING { color: #06c; }
+ pre#logview { background: #111; color: #dfe6ee; padding: 10px;
+               max-height: 420px; overflow: auto; font-size: 12px; }
+ a { color: #06c; cursor: pointer; }
+ #err { color: #b00; }
 </style></head>
 <body>
-<h1>ray_tpu cluster</h1>
+<h1>ray_tpu cluster <span id="ts" style="font-size:12px;color:#888"></span></h1>
+<div id="err"></div>
 <div id="content">loading…</div>
+<h2>Logs</h2>
+<div id="logfiles"></div>
+<pre id="logview" style="display:none"></pre>
 <script>
+function esc(s) { return String(s ?? '').replace(/[&<>"]/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;','"':'&quot;'}[c])); }
+function bar(frac) {
+  const pct = Math.round(Math.min(Math.max(frac, 0), 1) * 100);
+  return `<span class="barbox"><span class="bar" style="width:${pct}%"></span>` +
+         `</span>${pct}%`;
+}
+async function viewLog(nodeId, name) {
+  const r = await fetch(`/api/log?node_id=${encodeURIComponent(nodeId)}` +
+                        `&name=${encodeURIComponent(name)}`);
+  const data = await r.json();
+  const v = document.getElementById('logview');
+  v.style.display = 'block';
+  v.textContent = data.error ? `error: ${data.error}` : data.text;
+  v.scrollTop = v.scrollHeight;
+}
+async function loadLogs(nodeId) {
+  const files = await fetch(
+    `/api/logs?node_id=${encodeURIComponent(nodeId)}`).then(r => r.json());
+  // filenames are attacker-influencable: never interpolate them into
+  // executable JS — build DOM nodes and carry names via dataset
+  const box = document.getElementById('logfiles');
+  box.textContent = files.length ? '' : 'no log files';
+  const label = document.createElement('b');
+  label.textContent = `node ${nodeId.slice(0, 8)}: `;
+  box.appendChild(label);
+  for (const f of files) {
+    const a = document.createElement('a');
+    a.textContent = f.name;
+    a.dataset.node = nodeId;
+    a.dataset.name = f.name;
+    a.addEventListener('click',
+      e => viewLog(e.target.dataset.node, e.target.dataset.name));
+    box.appendChild(a);
+    box.appendChild(document.createTextNode(` (${f.size}b) `));
+  }
+}
 async function load() {
-  const [nodes, actors, jobs, status] = await Promise.all([
-    fetch('/api/nodes').then(r => r.json()),
-    fetch('/api/actors').then(r => r.json()),
-    fetch('/api/jobs').then(r => r.json()),
-    fetch('/api/cluster_status').then(r => r.json()),
-  ]);
-  let html = '<h2>Nodes</h2><table><tr><th>name</th><th>alive</th><th>resources</th></tr>';
-  for (const n of nodes) {
-    const res = Object.entries(n.total).map(
-      ([k, v]) => `${k}: ${n.available[k] ?? 0}/${v}`).join(', ');
-    html += `<tr><td>${n.node_name}</td><td>${n.alive}</td><td>${res}</td></tr>`;
+  try {
+    const [nodes, metrics, actors, jobs, status, tasks, summary] =
+      await Promise.all([
+        fetch('/api/nodes').then(r => r.json()),
+        fetch('/api/node_metrics').then(r => r.json()),
+        fetch('/api/actors').then(r => r.json()),
+        fetch('/api/jobs').then(r => r.json()),
+        fetch('/api/cluster_status').then(r => r.json()),
+        fetch('/api/tasks?limit=100').then(r => r.json()),
+        fetch('/api/task_summary').then(r => r.json()),
+      ]);
+    let html = '<h2>Nodes</h2><table><tr><th>node</th><th>name</th>' +
+      '<th>alive</th><th>CPU</th><th>mem</th><th>object store</th>' +
+      '<th>resources</th><th>logs</th></tr>';
+    for (const n of nodes) {
+      const m = metrics[n.node_id] || {};
+      const cpuT = n.total.CPU || 0, cpuA = n.available.CPU ?? cpuT;
+      const res = Object.entries(n.total).map(
+        ([k, v]) => `${k}: ${(v - (n.available[k] ?? 0)).toFixed(1)}/${v}`)
+        .join(', ');
+      html += `<tr><td>${esc(n.node_id.slice(0, 8))}</td>` +
+        `<td>${esc(n.node_name)}</td>` +
+        `<td class="${n.alive ? 'alive' : 'dead'}">${n.alive}</td>` +
+        `<td>${cpuT ? bar((cpuT - cpuA) / cpuT) : '—'}</td>` +
+        `<td>${m.mem_frac != null ? bar(m.mem_frac) : '—'}</td>` +
+        `<td>${m.store_frac != null ? bar(m.store_frac) : '—'}</td>` +
+        `<td>${esc(res)}</td>` +
+        `<td><a onclick="loadLogs('${n.node_id}')">browse</a></td></tr>`;
+    }
+    html += '</table>';
+    html += `<h2>Pending demand</h2><p>${esc(JSON.stringify(status.pending_demand))}</p>`;
+    html += '<h2>Task summary</h2><table><tr><th>task</th><th>states</th></tr>';
+    for (const [name, states] of Object.entries(summary))
+      html += `<tr><td>${esc(name)}</td><td>${Object.entries(states).map(
+        ([s, c]) => `<span class="state-${s}">${s}: ${c}</span>`).join(' ')}` +
+        `</td></tr>`;
+    html += '</table>';
+    html += '<h2>Recent tasks</h2><table><tr><th>task</th><th>type</th>' +
+      '<th>state</th><th>node</th><th>pid</th><th>dur (s)</th></tr>';
+    for (const t of tasks.slice(-40).reverse()) {
+      const st = t.state_ts || {};
+      const end = st.FINISHED || st.FAILED;
+      const dur = st.RUNNING && end ? (end - st.RUNNING).toFixed(3) : '';
+      html += `<tr><td>${esc(t.name)}</td><td>${esc(t.type)}</td>` +
+        `<td class="state-${t.state}">${t.state}</td>` +
+        `<td>${esc((t.node_id || '').slice(0, 8))}</td>` +
+        `<td>${t.pid ?? ''}</td><td>${dur}</td></tr>`;
+    }
+    html += '</table>';
+    html += '<h2>Actors</h2><table><tr><th>class</th><th>name</th>' +
+      '<th>state</th><th>node</th><th>pid</th><th>restarts</th></tr>';
+    for (const a of actors)
+      html += `<tr><td>${esc(a.class_name)}</td><td>${esc(a.name)}</td>` +
+        `<td>${esc(a.state)}</td><td>${esc((a.node_id || '').slice(0, 8))}</td>` +
+        `<td>${a.pid ?? ''}</td><td>${a.num_restarts}</td></tr>`;
+    html += '</table>';
+    html += '<h2>Jobs</h2><table><tr><th>id</th><th>status</th><th>entrypoint</th></tr>';
+    for (const j of jobs)
+      html += `<tr><td>${esc(j.submission_id ?? j.job_id)}</td>` +
+        `<td>${esc(j.status)}</td><td>${esc(j.entrypoint)}</td></tr>`;
+    html += '</table>';
+    document.getElementById('content').innerHTML = html;
+    document.getElementById('ts').textContent = new Date().toLocaleTimeString();
+    document.getElementById('err').textContent = '';
+  } catch (e) {
+    document.getElementById('err').textContent = 'refresh failed: ' + e;
   }
-  html += '</table>';
-  html += `<h2>Pending demand</h2><p>${JSON.stringify(status.pending_demand)}</p>`;
-  html += '<h2>Actors</h2><table><tr><th>class</th><th>name</th><th>state</th><th>restarts</th></tr>';
-  for (const a of actors) {
-    html += `<tr><td>${a.class_name}</td><td>${a.name ?? ''}</td>` +
-            `<td>${a.state}</td><td>${a.num_restarts}</td></tr>`;
-  }
-  html += '</table>';
-  html += '<h2>Jobs</h2><table><tr><th>id</th><th>status</th><th>entrypoint</th></tr>';
-  for (const j of jobs) {
-    html += `<tr><td>${j.submission_id ?? j.job_id}</td><td>${j.status}</td>` +
-            `<td>${j.entrypoint ?? ''}</td></tr>`;
-  }
-  html += '</table>';
-  document.getElementById('content').innerHTML = html;
 }
 load();
+setInterval(load, 5000);
 </script></body></html>
 """
 
 
 class Dashboard:
     def __init__(self, gcs_addr: Tuple[str, int]):
+        import threading
+
         self.gcs_addr = gcs_addr
         self._conn = None
         self._io = None
+        # the page's first load fires several API calls concurrently; their
+        # executor threads must not each build an EventLoopThread/connection
+        self._conn_lock = threading.Lock()
 
     def _call(self, method: str, msg=None):
         from ray_tpu._private import rpc
         from ray_tpu._private.rpc import EventLoopThread
 
-        if self._io is None:
-            self._io = EventLoopThread(name="dashboard-gcs")
-        if self._conn is None or self._conn.closed:
-            self._conn = self._io.run(
-                rpc.connect(*self.gcs_addr, name="dashboard->gcs"))
-        return self._conn.call_sync(method, msg, timeout=30)
+        with self._conn_lock:
+            if self._io is None:
+                self._io = EventLoopThread(name="dashboard-gcs")
+            if self._conn is None or self._conn.closed:
+                self._conn = self._io.run(
+                    rpc.connect(*self.gcs_addr, name="dashboard->gcs"))
+            conn = self._conn
+        return conn.call_sync(method, msg, timeout=30)
+
+    def _nodelet_call(self, addr, method: str, msg=None):
+        from ray_tpu._private import rpc
+
+        async def call():
+            conn = await rpc.connect(*addr, name="dashboard->nodelet")
+            try:
+                return await conn.call(method, msg, timeout=15)
+            finally:
+                await conn.close()
+
+        return self._io.run(call())
 
     # ------------------------------------------------------------ handlers
     async def serve(self, host: str = "127.0.0.1", port: int = 8265) -> int:
@@ -96,19 +222,74 @@ class Dashboard:
         def offload(fn):
             async def handler(request):
                 try:
-                    data = await loop.run_in_executor(None, fn)
+                    data = await loop.run_in_executor(
+                        None, fn, *([request] if fn.__code__.co_argcount else []))
                 except Exception as e:
                     return web.json_response(
                         {"error": f"{type(e).__name__}: {e}"}, status=500)
                 return web.json_response(data)
             return handler
 
+        def raw_nodes():
+            return self._call("get_all_node_info")
+
         def nodes():
             out = []
-            for n in self._call("get_all_node_info"):
+            for n in raw_nodes():
                 n = dict(n)
                 n["node_id"] = n["node_id"].hex()
                 out.append(n)
+            return out
+
+        def node_metrics():
+            """Per-node utilization from each nodelet's metric registry.
+            Returns {node_id_hex: {mem_frac, store_frac, raw gauges...}}.
+            Scrapes fan out CONCURRENTLY with a tight per-node timeout — a
+            64-host pod must not serialize 64 round-trips per page refresh,
+            and one unreachable nodelet must not stall the endpoint."""
+            from ray_tpu._private import rpc as _rpc
+
+            alive = [n for n in raw_nodes() if n["alive"]]
+
+            async def scrape(n):
+                try:
+                    conn = await asyncio.wait_for(
+                        _rpc.connect(*tuple(n["addr"]),
+                                     name="dashboard->nodelet"), 2.0)
+                    try:
+                        return n, await conn.call("get_metrics_text", None,
+                                                  timeout=3.0)
+                    finally:
+                        await conn.close()
+                except Exception:
+                    return n, None
+
+            async def scrape_all():
+                return await asyncio.gather(*(scrape(n) for n in alive))
+
+            out: Dict[str, dict] = {}
+            with self._conn_lock:
+                io = self._io
+            for n, text in io.run(scrape_all()):
+                if text is None:
+                    continue
+                hexid = n["node_id"].hex()
+                gauges = _parse_prometheus(text)
+
+                def g(name):  # registry exports with the ray_tpu_ prefix
+                    return gauges.get(f"ray_tpu_{name}", gauges.get(name))
+
+                mem_used = g("node_mem_used_bytes")
+                mem_total = g("node_mem_total_bytes")
+                store_used = g("object_store_bytes_used")
+                store_cap = g("object_store_capacity_bytes")
+                out[hexid] = {
+                    "mem_frac": (mem_used / mem_total)
+                    if mem_used is not None and mem_total else None,
+                    "store_frac": (store_used / store_cap)
+                    if store_used is not None and store_cap else None,
+                    "gauges": gauges,
+                }
             return out
 
         def actors():
@@ -132,17 +313,65 @@ class Dashboard:
                 n["node_id"] = n["node_id"].hex()
             return st
 
-        def tasks():
-            return self._call("get_task_events", {"limit": 1000})
+        # One bounded fetch feeds BOTH task endpoints: the page polls them
+        # together every 5 s, so a short-TTL cache halves the GCS load and
+        # keeps it independent of cluster age (events capped, not history).
+        task_cache = {"ts": 0.0, "rows": []}
+        task_cache_lock = __import__("threading").Lock()
+
+        def _folded_tasks():
+            import time as _time
+
+            with task_cache_lock:
+                if _time.monotonic() - task_cache["ts"] > 2.0:
+                    events = self._call("get_task_events", {"limit": 20_000})
+                    task_cache["rows"] = _fold_tasks(events, 100_000)
+                    task_cache["ts"] = _time.monotonic()
+                return task_cache["rows"]
+
+        def tasks(request):
+            limit = int(request.query.get("limit", 1000))
+            return _folded_tasks()[-limit:]
+
+        def task_summary():
+            summary: Dict[str, Dict[str, int]] = {}
+            for row in _folded_tasks():
+                per = summary.setdefault(row["name"] or "?", {})
+                per[row["state"]] = per.get(row["state"], 0) + 1
+            return summary
+
+        def _node_addr(node_id_hex: str):
+            for n in raw_nodes():
+                if n["node_id"].hex() == node_id_hex and n["alive"]:
+                    return tuple(n["addr"])
+            raise ValueError(f"no alive node {node_id_hex}")
+
+        def logs(request):
+            addr = _node_addr(request.query["node_id"])
+            return self._nodelet_call(addr, "list_log_files")
+
+        def log_tail(request):
+            addr = _node_addr(request.query["node_id"])
+            blob = self._nodelet_call(
+                addr, "tail_log",
+                {"name": request.query["name"],
+                 "nbytes": int(request.query.get("nbytes", 64 * 1024))})
+            if blob is None:
+                raise FileNotFoundError(request.query["name"])
+            return {"text": blob.decode(errors="replace")}
 
         app = web.Application()
         app.router.add_get("/", lambda r: web.Response(
             text=_PAGE, content_type="text/html"))
         app.router.add_get("/api/nodes", offload(nodes))
+        app.router.add_get("/api/node_metrics", offload(node_metrics))
         app.router.add_get("/api/actors", offload(actors))
         app.router.add_get("/api/jobs", offload(jobs))
         app.router.add_get("/api/cluster_status", offload(cluster_status))
         app.router.add_get("/api/tasks", offload(tasks))
+        app.router.add_get("/api/task_summary", offload(task_summary))
+        app.router.add_get("/api/logs", offload(logs))
+        app.router.add_get("/api/log", offload(log_tail))
         runner = web.AppRunner(app, access_log=None)
         await runner.setup()
         site = web.TCPSite(runner, host, port)
@@ -150,6 +379,25 @@ class Dashboard:
         for sock in site._server.sockets:  # type: ignore[union-attr]
             return sock.getsockname()[1]
         return port
+
+
+def _parse_prometheus(text: str) -> Dict[str, float]:
+    """Flatten a Prometheus exposition into {metric_name: value} (labels
+    dropped; last sample wins — enough for single-node gauges)."""
+    out: Dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            name_part, value = line.rsplit(None, 1)
+            name = name_part.split("{", 1)[0]
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
 
 
 def run(address: str, *, host: str = "127.0.0.1",

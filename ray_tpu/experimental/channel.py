@@ -280,3 +280,249 @@ class ShmChannel:
     def __reduce__(self):
         # channels travel by name; the receiving process attaches
         return (type(self), (self.name,))
+
+
+# ===================================================== cross-host channels
+
+_KV_NS = "_dagchan"
+
+
+def _kv_call(method: str, msg: dict):
+    from ray_tpu._private.worker import require_core
+
+    return require_core().gcs_call_sync(method, msg)
+
+
+def _node_advertise_host() -> str:
+    """The host other nodes can reach this process's NODE at: the nodelet's
+    GCS-registered address (the worker's own RPC server binds loopback, so
+    ``core.addr`` would advertise 127.0.0.1 and break genuinely-cross-host
+    edges).  Cached on the core — one nodelet round-trip per process."""
+    try:
+        from ray_tpu._private.worker import require_core
+
+        core = require_core()
+        host = getattr(core, "_chan_advertise_host", None)
+        if host is None:
+            info = core.io.run(core.nodelet_conn.call("node_info", None))
+            host = info["addr"][0] or "127.0.0.1"
+            core._chan_advertise_host = host
+        return host
+    except Exception:
+        import logging
+
+        # a loopback fallback on a multi-host pod makes the remote reader
+        # time out against its own loopback — leave a trail to the cause
+        logging.getLogger(__name__).warning(
+            "could not resolve this node's advertise host; tcp channel "
+            "falls back to 127.0.0.1 (cross-host readers will not reach "
+            "it)", exc_info=True)
+        return "127.0.0.1"
+
+
+class TcpChannel:
+    """One cross-host SPSC edge: length-framed messages over a single TCP
+    connection with credit-based depth backpressure.
+
+    The shm ring cannot span hosts; a compiled-DAG edge whose endpoints live
+    on different nodes falls back to this channel (reference: the remote-
+    reader path of shared_memory_channel.py — there the object store bridges
+    nodes; here a dedicated socket does, keeping the no-per-message-runtime
+    property).  Rendezvous rides the GCS KV: the writer binds an ephemeral
+    port and registers ``name -> (host, port)`` under the ``_dagchan``
+    namespace; the reader polls the key and connects.
+
+    Backpressure mirrors the ring's ``depth``: the writer starts with
+    ``depth`` credits, each message costs one, and the reader returns one
+    1-byte ack per message consumed — so a slow consumer stalls the producer
+    after ``depth`` in-flight messages exactly like the shm ring does.
+    """
+
+    def __init__(self, name: str, *, role: str, depth: int = 2,
+                 advertise_host: Optional[str] = None,
+                 connect_timeout: float = 60.0):
+        import socket
+
+        assert role in ("r", "w")
+        self.name = name
+        self.role = role
+        self.depth = depth
+        self.slot_size = 1 << 62  # no framing limit; kept for API parity
+        self._sock: Optional[socket.socket] = None
+        self._listener: Optional[socket.socket] = None
+        self._credits = depth
+        self._connect_timeout = connect_timeout
+        self._registered = False
+        self._closed = False
+        if role == "w":
+            if advertise_host is None:
+                advertise_host = _node_advertise_host()
+            ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            host = advertise_host
+            ls.bind((host if host != "0.0.0.0" else "", 0))
+            ls.listen(1)
+            self._listener = ls
+            port = ls.getsockname()[1]
+            _kv_call("kv_put", {"ns": _KV_NS, "key": name,
+                                "value": pickle.dumps((host, port))})
+            self._registered = True
+
+    # ---------------------------------------------------------- connection
+    def _ensure_conn(self, timeout: Optional[float]) -> None:
+        import socket
+
+        if self._sock is not None:
+            return
+        if self._closed:
+            raise ChannelClosed(f"tcp channel {self.name} is closed")
+        budget = self._connect_timeout if timeout is None else timeout
+        deadline = time.monotonic() + budget
+        if self.role == "w":
+            self._listener.settimeout(budget)
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                raise TimeoutError(
+                    f"tcp channel {self.name}: reader never connected")
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = conn
+            return
+        # reader: poll the rendezvous key, then connect
+        addr = None
+        while addr is None:
+            blob = _kv_call("kv_get", {"ns": _KV_NS, "key": self.name})
+            if blob is not None:
+                addr = pickle.loads(blob)
+                break
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"tcp channel {self.name}: writer never registered")
+            time.sleep(0.02)
+        while True:
+            try:
+                s = socket.create_connection(
+                    tuple(addr), timeout=max(deadline - time.monotonic(), 0.1))
+                break
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"tcp channel {self.name}: connect to {addr} failed")
+                time.sleep(0.05)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = s
+
+    def _recv_exact(self, n: int, timeout: Optional[float]) -> bytes:
+        import socket
+
+        self._sock.settimeout(timeout)
+        chunks = []
+        got = 0
+        try:
+            while got < n:
+                c = self._sock.recv(min(n - got, 1 << 20))
+                if not c:
+                    raise ChannelClosed(
+                        f"tcp channel {self.name}: peer disconnected")
+                chunks.append(c)
+                got += len(c)
+        except socket.timeout:
+            if chunks:
+                # mid-frame timeout would desync the stream; fail hard
+                raise ChannelClosed(
+                    f"tcp channel {self.name}: truncated frame")
+            raise TimeoutError("channel wait timed out")
+        return b"".join(chunks)
+
+    # -------------------------------------------------------------- write
+    def _drain_acks(self) -> None:
+        """Non-blocking credit replenish."""
+        import socket
+
+        self._sock.settimeout(0.0)
+        try:
+            while True:
+                c = self._sock.recv(4096)
+                if not c:
+                    raise ChannelClosed(
+                        f"tcp channel {self.name}: peer disconnected")
+                self._credits += len(c)
+        except (BlockingIOError, socket.timeout, InterruptedError):
+            pass
+
+    def wait_writable(self, timeout: Optional[float] = None) -> None:
+        self._ensure_conn(timeout)
+        self._drain_acks()
+        if self._credits > 0:
+            return
+        ack = self._recv_exact(1, timeout)  # blocking credit wait
+        self._credits += len(ack)
+        self._drain_acks()
+
+    def write_bytes(self, payload: bytes, timeout: Optional[float] = None) -> None:
+        self.wait_writable(timeout)
+        self._sock.settimeout(None)
+        self._sock.sendall(len(payload).to_bytes(8, "little") + payload)
+        self._credits -= 1
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        self.write_bytes(pickle.dumps(value, protocol=5), timeout)
+
+    def close_write(self, timeout: float = 60.0) -> None:
+        try:
+            # A reader that never connected cannot be blocked on data, so
+            # the EOF sentinel only matters for a connected peer: bound the
+            # accept wait tightly or teardown of a dead downstream would
+            # stall `timeout` seconds per unconnected edge.
+            self._ensure_conn(timeout if self._sock is not None
+                              else min(timeout, 5.0))
+            self._sock.settimeout(timeout)
+            self._sock.sendall(_LEN_CLOSE.to_bytes(8, "little"))
+        except Exception:
+            pass
+
+    # --------------------------------------------------------------- read
+    def read_bytes(self, timeout: Optional[float] = None) -> bytes:
+        self._ensure_conn(timeout)
+        head = self._recv_exact(8, timeout)
+        n = int.from_bytes(head, "little")
+        if n == _LEN_CLOSE:
+            raise ChannelClosed("producer closed the channel")
+        payload = self._recv_exact(n, None if timeout is None else timeout)
+        self._sock.settimeout(None)
+        self._sock.sendall(b"\x01")  # return one credit
+        return payload
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        return pickle.loads(self.read_bytes(timeout))
+
+    # ----------------------------------------------------------- lifecycle
+    def close(self) -> None:
+        self._closed = True
+        for s in (self._sock, self._listener):
+            if s is not None:
+                try:
+                    s.close()
+                except Exception:
+                    pass
+        self._sock = self._listener = None
+        if self._registered:
+            self._registered = False
+            try:
+                _kv_call("kv_del", {"ns": _KV_NS, "key": self.name})
+            except Exception:
+                pass
+
+
+def open_channel(desc, role: str):
+    """Materialize one compiled-DAG edge endpoint from its descriptor.
+
+    ``desc`` is either a bare shm segment name (same-node edge: attach to the
+    driver-created ring) or ``("tcp", chan_id, depth)`` for a cross-node edge.
+    """
+    if isinstance(desc, str):
+        return ShmChannel(desc)
+    kind = desc[0]
+    if kind == "tcp":
+        return TcpChannel(desc[1], role=role, depth=desc[2])
+    raise ValueError(f"unknown channel descriptor {desc!r}")

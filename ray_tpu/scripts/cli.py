@@ -162,6 +162,23 @@ def _cmd_timeline(args) -> int:
     return 0
 
 
+def _cmd_logs(args) -> int:
+    """List/tail log files across the cluster (reference:
+    python/ray/_private/log_monitor.py + `ray logs` in scripts.py)."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    address = _resolve_address(args.address)
+    ray_tpu.init(address=address, ignore_reinit_error=True)
+    if args.filename is None:
+        for f in state.list_logs(node_id=args.node_id):
+            print(f"{f['size']:>10}  {f['name']}")
+        return 0
+    sys.stdout.write(state.get_log(args.filename, node_id=args.node_id,
+                                   tail=args.tail))
+    return 0
+
+
 def _cmd_job(args) -> int:
     from ray_tpu.job_submission import JobSubmissionClient
 
@@ -253,6 +270,16 @@ def main(argv=None) -> int:
     p.add_argument("--address", default=None)
     p.add_argument("--output", default=None)
     p.set_defaults(fn=_cmd_timeline)
+
+    p = sub.add_parser("logs", help="list or tail cluster log files")
+    p.add_argument("filename", nargs="?", default=None,
+                   help="log file to tail (omit to list)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--node-id", default=None,
+                   help="node id (hex prefix ok); default: head node")
+    p.add_argument("--tail", type=int, default=64 * 1024,
+                   help="bytes from the end of the file")
+    p.set_defaults(fn=_cmd_logs)
 
     p = sub.add_parser("job", help="submit and manage jobs")
     jsub = p.add_subparsers(dest="job_cmd", required=True)

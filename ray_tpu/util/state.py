@@ -73,36 +73,10 @@ def list_tasks(limit: int = 1000, job_id: Optional[str] = None,
                name: Optional[str] = None) -> List[Dict[str, Any]]:
     """One row per (task, attempt), folded from lifecycle events: latest
     state plus per-state timestamps."""
+    from ray_tpu._private.taskfold import fold_task_events
+
     events = _gcs_call("get_task_events", {"limit": 100_000})
-    rows: Dict[tuple, Dict[str, Any]] = {}
-    # Driver and workers flush on independent timers, so GCS arrival order is
-    # not event order — fold by emission timestamp (rank breaks exact ties).
-    _rank = {"SUBMITTED": 0, "RUNNING": 1, "FAILED": 2, "FINISHED": 2}
-    for ev in sorted(events, key=lambda e: (e["ts"], _rank.get(e["state"], 1))):
-        if job_id is not None and ev.get("job_id") != job_id:
-            continue
-        if name is not None and ev.get("name") != name:
-            continue
-        key = (ev["task_id"], ev.get("attempt", 0))
-        row = rows.setdefault(key, {
-            "task_id": ev["task_id"],
-            "attempt": ev.get("attempt", 0),
-            "name": ev.get("name"),
-            "type": ev.get("type"),
-            "job_id": ev.get("job_id"),
-            "actor_id": ev.get("actor_id"),
-            "trace_id": ev.get("trace_id"),
-            "span_id": ev.get("span_id"),
-            "parent_span_id": ev.get("parent_span_id"),
-            "state_ts": {},
-        })
-        row["state_ts"][ev["state"]] = ev["ts"]
-        row["state"] = ev["state"]
-        for k in ("node_id", "worker_id", "pid", "error"):
-            if ev.get(k) is not None:
-                row[k] = ev[k]
-    out = list(rows.values())[-limit:]
-    return out
+    return fold_task_events(events, limit, job_id=job_id, name=name)
 
 
 def summarize_tasks() -> Dict[str, Dict[str, int]]:
@@ -112,6 +86,50 @@ def summarize_tasks() -> Dict[str, Dict[str, int]]:
         per = summary.setdefault(row["name"] or "?", {})
         per[row["state"]] = per.get(row["state"], 0) + 1
     return summary
+
+
+def _nodelet_call(node_id: Optional[str], method: str, msg=None):
+    """RPC straight to one node's nodelet (address from the GCS node table).
+    ``node_id=None`` targets the first alive node."""
+    from ray_tpu._private import rpc
+
+    core = require_core()
+    target = None
+    for n in _gcs_call("get_all_node_info", None):
+        hexid = NodeID(n["node_id"]).hex()
+        if not n["alive"]:
+            continue
+        if node_id is None or hexid == node_id or hexid.startswith(node_id):
+            target = tuple(n["addr"])
+            break
+    if target is None:
+        raise ValueError(f"no alive node matching {node_id!r}")
+
+    async def call():
+        conn = await rpc.connect(*target, name="state->nodelet")
+        try:
+            return await conn.call(method, msg, timeout=30)
+        finally:
+            await conn.close()
+
+    return core.io.run(call())
+
+
+def list_logs(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Log files on one node (worker stdout, nodelet/gcs logs) — the
+    ``ray logs`` surface (reference: python/ray/_private/log_monitor.py,
+    python/ray/util/state/api.py list_logs)."""
+    return _nodelet_call(node_id, "list_log_files")
+
+
+def get_log(filename: str, node_id: Optional[str] = None,
+            tail: int = 64 * 1024) -> str:
+    """Tail of one log file on one node (reference: state api get_log)."""
+    blob = _nodelet_call(node_id, "tail_log",
+                         {"name": filename, "nbytes": tail})
+    if blob is None:
+        raise FileNotFoundError(f"{filename} on node {node_id or '<head>'}")
+    return blob.decode(errors="replace")
 
 
 def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:

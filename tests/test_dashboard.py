@@ -68,6 +68,48 @@ def test_dashboard_endpoints(cluster):
     assert "pending_demand" in status
     jobs = get("/api/jobs")
     assert isinstance(jobs, list)
+
+    # task table: the marker's ping must appear with a full lifecycle
+    import time as _t
+
+    deadline = _t.time() + 30
+    while _t.time() < deadline:
+        tasks = get("/api/tasks?limit=1000")
+        if any(t["name"] == "ping" and t["state"] == "FINISHED"
+               for t in tasks):
+            break
+        _t.sleep(0.5)
+    else:
+        raise AssertionError(f"Marker.ping never FINISHED in /api/tasks: "
+                             f"{[t['name'] for t in tasks][:20]}")
+    summary = get("/api/task_summary")
+    assert "ping" in summary
+
+    # per-node utilization parsed from the nodelet metric registries
+    metrics = get("/api/node_metrics")
+    alive = [n for n in nodes if n["alive"]]
+    assert any(n["node_id"] in metrics for n in alive)
+    some = next(m for m in metrics.values())
+    assert some["mem_frac"] is None or 0 <= some["mem_frac"] <= 1
+
+    # log browser: list + tail through the dashboard
+    node_id = alive[0]["node_id"]
+    files = get(f"/api/logs?node_id={node_id}")
+    assert isinstance(files, list) and files, "no log files listed"
+    tail = get(f"/api/log?node_id={node_id}&name={files[0]['name']}")
+    assert "text" in tail
+
     with urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=30) as r:
         assert b"ray_tpu" in r.read()
     ray_tpu.kill(m)
+
+
+def test_state_log_api(cluster):
+    """Driver-side `ray logs` equivalent (reference: util/state get_log)."""
+    from ray_tpu.util import state
+
+    files = state.list_logs()
+    assert isinstance(files, list)
+    if files:
+        text = state.get_log(files[0]["name"], tail=1024)
+        assert isinstance(text, str)
