@@ -38,17 +38,31 @@ sys.path.insert(0, __import__("os").path.dirname(
 from ray_tpu.ops.attention import flash_attention, mha_reference  # noqa: E402
 
 
-def _time_fn(fn, *args, iters=20, warmup=3):
+def _fetch(x):
+    """Force completion by copying real bytes to host: on the axon tunnel
+    block_until_ready can return early (see bench.py sync()), but a
+    device->host copy of data cannot lie."""
+    import numpy as np
+
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return np.asarray(leaf.ravel()[0])
+
+
+def _time_fn(fn, q, k, v, iters=20, warmup=2, chain=True):
+    """Median-free pipelined timing: the per-dispatch tunnel round-trip here
+    is ~70 ms, far above kernel compute, so per-call sync timing measures the
+    tunnel, not the chip.  Instead dispatch `iters` dependent calls (output
+    feeds the next q, so the device cannot overlap them) and fetch once —
+    per-iter time = chip compute + amortized dispatch."""
     for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    times = []
+        out = fn(q, k, v)
+    _fetch(out)
+    t0 = time.perf_counter()
+    cur = q
     for _ in range(iters):
-        t0 = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
-    return statistics.median(times), out
+        cur = fn(cur, k, v)
+    _fetch(cur)
+    return (time.perf_counter() - t0) / iters, cur
 
 
 def attn_flops(b, h, s_q, s_k, d, causal, bwd=False):
@@ -92,7 +106,10 @@ def phase_correctness(report):
         bwd_err = max(float(jnp.max(jnp.abs(a.astype(jnp.float32)
                                             - b_.astype(jnp.float32))))
                       for a, b_ in zip(g_f, g_r))
-        tol = 5e-2 if dt == jnp.bfloat16 else 2e-3
+        # f32 tolerance is TPU-loose: the MXU's default f32 matmul uses
+        # bf16 multiplies (jax default_matmul_precision), so the XLA
+        # reference itself carries ~1e-2 error vs true f32
+        tol = 5e-2 if dt == jnp.bfloat16 else 2e-2
         # grads scale with values; use a looser relative-ish cap
         gtol = tol * 40
         ok = fwd_err < tol and bwd_err < gtol
@@ -134,7 +151,9 @@ def phase_tuning(report, quick):
                 def lf(q, k, v, _f=f):
                     return jnp.sum(_f(q, k, v).astype(jnp.float32) ** 2)
 
-                gf = jax.jit(jax.grad(lf, argnums=(0, 1, 2)))
+                _g = jax.jit(jax.grad(lf, argnums=(0, 1, 2)))
+                # chainable forms: output feeds the next call's q
+                gf = lambda q, k, v, _g=_g: _g(q, k, v)[0]  # noqa: E731
                 try:
                     t_f, _ = _time_fn(f, q, k, v, iters=10)
                     t_b, _ = _time_fn(gf, q, k, v, iters=10)
@@ -157,7 +176,7 @@ def phase_vs_xla(report, quick, summary):
     report.append("## 3. flash vs XLA attention (causal bf16, b*h=32, d=64)\n")
     report.append("| seq | flash fwd ms | xla fwd ms | speedup | flash f+b ms | xla f+b ms | speedup |")
     report.append("|---|---|---|---|---|---|---|")
-    seqs = [1024, 2048, 4096] if quick else [1024, 2048, 4096, 8192, 16384]
+    seqs = [1024, 4096] if quick else [1024, 2048, 4096, 8192, 16384]
     b, h, d = 4, 8, 64
     flash_j = jax.jit(functools.partial(flash_attention, causal=True))
     ref_j = jax.jit(functools.partial(mha_reference, causal=True))
@@ -168,8 +187,10 @@ def phase_vs_xla(report, quick, summary):
     def lref(q, k, v):
         return jnp.sum(ref_j(q, k, v).astype(jnp.float32) ** 2)
 
-    gflash = jax.jit(jax.grad(lflash, argnums=(0, 1, 2)))
-    gref = jax.jit(jax.grad(lref, argnums=(0, 1, 2)))
+    _gflash = jax.jit(jax.grad(lflash, argnums=(0, 1, 2)))
+    _gref = jax.jit(jax.grad(lref, argnums=(0, 1, 2)))
+    gflash = lambda q, k, v: _gflash(q, k, v)[0]  # noqa: E731
+    gref = lambda q, k, v: _gref(q, k, v)[0]  # noqa: E731
     for s in seqs:
         key = jax.random.PRNGKey(2)
         k1, k2, k3 = jax.random.split(key, 3)
@@ -208,10 +229,15 @@ def main():
     summary = {"device": dev.device_kind, "platform": "tpu"}
 
     t0 = time.time()
+    print("phase 1: correctness...", flush=True)
     ok = phase_correctness(report)
     summary["correctness"] = "pass" if ok else "FAIL"
+    print(f"phase 1 done ({time.time()-t0:.0f}s); phase 2: block sweep...",
+          flush=True)
     best = phase_tuning(report, args.quick)
     summary["best_blocks"] = {k: list(v) for k, v in best.items()}
+    print(f"phase 2 done ({time.time()-t0:.0f}s); phase 3: vs XLA...",
+          flush=True)
     phase_vs_xla(report, args.quick, summary)
     summary["wall_s"] = round(time.time() - t0, 1)
 

@@ -41,7 +41,7 @@ _MAX_INDEX = 2 ** (OBJECT_ID_INDEX_BYTES * 8) - 1
 
 
 class BaseID:
-    __slots__ = ("_binary",)
+    __slots__ = ("_binary", "_hash")
     SIZE = 0
 
     def __init__(self, binary: bytes):
@@ -50,6 +50,10 @@ class BaseID:
                 f"{type(self).__name__} requires {self.SIZE} bytes, got {binary!r}"
             )
         self._binary = binary
+        # IDs key every hot-path dict (ref counts, memory store, inflight
+        # registries: ~16 hash lookups per task); bytes.__hash__ re-scans
+        # the payload each time, so cache it once
+        self._hash = hash(binary)
 
     @classmethod
     def from_random(cls):
@@ -73,7 +77,7 @@ class BaseID:
         return self._binary.hex()
 
     def __hash__(self):
-        return hash(self._binary)
+        return self._hash
 
     def __eq__(self, other):
         return type(other) is type(self) and other._binary == self._binary

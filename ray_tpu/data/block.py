@@ -10,7 +10,11 @@ _internal/arrow_block.py — blocks are pyarrow Tables or pandas frames):
 - ``pyarrow.Table`` — schema-carrying columnar format; parquet reads stay
   Arrow end-to-end through map_batches(batch_format="pyarrow") and
   iter_batches(batch_format="pyarrow") with no numpy round-trip (arrow
-  buffers also pickle out-of-band, so plasma transport is zero-copy too).
+  buffers also pickle out-of-band, so plasma transport is zero-copy too);
+- ``pandas.DataFrame`` — a pandas pipeline (``from_pandas`` source or a
+  map_batches(batch_format="pandas") chain returning frames) flows
+  frame-native with no per-stage pivot (reference:
+  python/ray/data/_internal/pandas_block.py).
 
 ``BlockAccessor`` dispatches on the representation; all-to-all ops
 (sort/shuffle/groupby) pivot to numpy at their barrier, where a row pivot
@@ -20,12 +24,13 @@ columns, so arbitrary rows still fit the columnar frame.
 
 from __future__ import annotations
 
+import sys
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
 
-Block = Union[Dict[str, np.ndarray], "pyarrow.Table"]
+Block = Union[Dict[str, np.ndarray], "pyarrow.Table", "pandas.DataFrame"]
 Row = Dict[str, Any]
 
 
@@ -38,6 +43,16 @@ def is_arrow_block(block: Any) -> bool:
         return isinstance(block, pa.Table)
     except ImportError:
         return False
+
+
+def is_pandas_block(block: Any) -> bool:
+    if isinstance(block, dict):
+        return False
+    if "pandas" not in sys.modules:  # never import pandas just to say no
+        return False
+    import pandas as pd
+
+    return isinstance(block, pd.DataFrame)
 
 
 @dataclass
@@ -90,12 +105,16 @@ class BlockAccessor:
 
     @staticmethod
     def from_pandas(df) -> Block:
-        return {c: df[c].to_numpy() for c in df.columns}
+        """DataFrames ARE a block representation: pass through unchanged so
+        a pandas pipeline never pays a per-stage pivot."""
+        return df
 
     @staticmethod
     def to_pandas(block: Block):
         import pandas as pd
 
+        if is_pandas_block(block):
+            return block
         if is_arrow_block(block):
             return block.to_pandas()
         return pd.DataFrame({k: list(v) if v.ndim > 1 else v
@@ -117,6 +136,8 @@ class BlockAccessor:
         """Canonical numpy view (the jax hand-off / all-to-all pivot)."""
         if is_arrow_block(block):
             return BlockAccessor.from_arrow(block)
+        if is_pandas_block(block):
+            return {c: block[c].to_numpy() for c in block.columns}
         return block
 
     @staticmethod
@@ -125,6 +146,8 @@ class BlockAccessor:
 
         if is_arrow_block(block):
             return block
+        if is_pandas_block(block):
+            return pa.Table.from_pandas(block, preserve_index=False)
         return pa.table({k: (list(v) if v.ndim > 1 or v.dtype.kind == "O"
                              else v)
                          for k, v in block.items()})
@@ -134,6 +157,8 @@ class BlockAccessor:
     def num_rows(block: Block) -> int:
         if is_arrow_block(block):
             return block.num_rows
+        if is_pandas_block(block):
+            return len(block)
         if not block:
             return 0
         return len(next(iter(block.values())))
@@ -142,6 +167,8 @@ class BlockAccessor:
     def size_bytes(block: Block) -> int:
         if is_arrow_block(block):
             return block.nbytes
+        if is_pandas_block(block):
+            return int(block.memory_usage(index=False, deep=True).sum())
         total = 0
         for v in block.values():
             if v.dtype.kind == "O":
@@ -156,6 +183,8 @@ class BlockAccessor:
     def schema(block: Block) -> Dict[str, str]:
         if is_arrow_block(block):
             return {f.name: str(f.type) for f in block.schema}
+        if is_pandas_block(block):
+            return {c: str(block.dtypes[c]) for c in block.columns}
         out = {}
         for k, v in block.items():
             t = "object" if v.dtype.kind == "O" else str(v.dtype)
@@ -178,6 +207,8 @@ class BlockAccessor:
     def slice(block: Block, start: int, end: int) -> Block:
         if is_arrow_block(block):
             return block.slice(start, max(end - start, 0))
+        if is_pandas_block(block):
+            return block.iloc[start:end]
         return {k: v[start:end] for k, v in block.items()}
 
     @staticmethod
@@ -187,6 +218,13 @@ class BlockAccessor:
             return {}
         if len(blocks) == 1:
             return blocks[0]
+        if all(is_pandas_block(b) for b in blocks):
+            import pandas as pd
+
+            return pd.concat(list(blocks), ignore_index=True)
+        if any(is_pandas_block(b) for b in blocks):
+            blocks = [BlockAccessor.to_numpy_block(b)
+                      if is_pandas_block(b) else b for b in blocks]
         if all(is_arrow_block(b) for b in blocks):
             import pyarrow as pa
 
@@ -234,6 +272,11 @@ class BlockAccessor:
         if is_arrow_block(block):
             yield from block.to_pylist()
             return
+        if is_pandas_block(block):
+            cols = list(block.columns)
+            for tup in block.itertuples(index=False, name=None):
+                yield dict(zip(cols, tup))
+            return
         keys = list(block.keys())
         for i in range(BlockAccessor.num_rows(block)):
             yield {k: block[k][i] for k in keys}
@@ -244,6 +287,8 @@ class BlockAccessor:
             import pyarrow as pa
 
             return block.take(pa.array(np.asarray(idx)))
+        if is_pandas_block(block):
+            return block.iloc[np.asarray(idx)].reset_index(drop=True)
         return {k: v[idx] for k, v in block.items()}
 
     @staticmethod
@@ -254,6 +299,12 @@ class BlockAccessor:
                 raise KeyError(f"columns not in block: {missing}; "
                                f"available: {block.column_names}")
             return block.select(list(cols))
+        if is_pandas_block(block):
+            missing = [c for c in cols if c not in block.columns]
+            if missing:
+                raise KeyError(f"columns not in block: {missing}; "
+                               f"available: {list(block.columns)}")
+            return block[list(cols)]
         missing = [c for c in cols if c not in block]
         if missing:
             raise KeyError(f"columns not in block: {missing}; "
@@ -265,12 +316,17 @@ class BlockAccessor:
         if is_arrow_block(block):
             return block.drop_columns(
                 [c for c in cols if c in block.column_names])
+        if is_pandas_block(block):
+            return block.drop(columns=[c for c in cols
+                                       if c in block.columns])
         return {k: v for k, v in block.items() if k not in cols}
 
     @staticmethod
     def sort_key_array(block: Block, key: str, descending: bool = False):
         if is_arrow_block(block):
             col = block.column(key).to_numpy(zero_copy_only=False)
+        elif is_pandas_block(block):
+            col = block[key].to_numpy()
         else:
             col = block[key]
         order = np.argsort(col, kind="stable")
@@ -287,13 +343,8 @@ class BlockAccessor:
         if isinstance(batch, dict):
             return {k: v if isinstance(v, np.ndarray) else _column(list(v))
                     for k, v in batch.items()}
-        try:
-            import pandas as pd
-
-            if isinstance(batch, pd.DataFrame):
-                return BlockAccessor.from_pandas(batch)
-        except ImportError:
-            pass
+        if is_pandas_block(batch):
+            return batch  # frames pass through: pandas stays pandas
         try:
             import pyarrow as pa
 

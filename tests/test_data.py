@@ -346,3 +346,44 @@ def test_memory_budget_backpressure(cluster):
         assert max(peaks) <= ctx.max_buffered_bytes + slack, max(peaks)
     finally:
         ctx.max_buffered_bytes = old
+
+
+def test_pandas_native_blocks(cluster):
+    """Pandas is a first-class block representation: a from_pandas ->
+    map_batches(batch_format='pandas') chain flows frame-native with no
+    per-stage pivot (reference: data/_internal/pandas_block.py)."""
+    import pandas as pd
+
+    from ray_tpu.data.block import BlockAccessor, is_pandas_block
+
+    df = pd.DataFrame({"x": range(20), "tag": [f"t{i%3}" for i in range(20)]})
+    ds = ray_tpu.data.from_pandas(df)
+
+    def double(batch):
+        assert isinstance(batch, pd.DataFrame), type(batch)
+        out = batch.copy()
+        out["x"] = out["x"] * 2
+        return out
+
+    out = ds.map_batches(double, batch_format="pandas") \
+            .filter(lambda r: r["x"] % 4 == 0)
+    rows = out.take_all()
+    assert [r["x"] for r in rows] == [i * 2 for i in range(20) if i % 2 == 0]
+
+    # the accessor surface operates frame-native
+    blk = df
+    assert is_pandas_block(blk)
+    assert BlockAccessor.num_rows(blk) == 20
+    assert BlockAccessor.size_bytes(blk) > 0
+    assert BlockAccessor.schema(blk)["x"].startswith("int")
+    sl = BlockAccessor.slice(blk, 5, 10)
+    assert is_pandas_block(sl) and len(sl) == 5
+    cat = BlockAccessor.concat([sl, sl])
+    assert is_pandas_block(cat) and len(cat) == 10
+    sel = BlockAccessor.select(blk, ["tag"])
+    assert list(sel.columns) == ["tag"]
+    # sort + groupby pivot at the barrier but accept pandas input
+    agg = ray_tpu.data.from_pandas(df).groupby("tag").count().take_all()
+    assert sorted(r["count()"] for r in agg) == [6, 7, 7]
+    # to_pandas round-trip is the identity for frame blocks
+    assert BlockAccessor.to_pandas(blk) is blk
