@@ -25,7 +25,6 @@ from __future__ import annotations
 import argparse
 import functools
 import json
-import statistics
 import sys
 import time
 
@@ -48,7 +47,7 @@ def _fetch(x):
     return np.asarray(leaf.ravel()[0])
 
 
-def _time_fn(fn, q, k, v, iters=20, warmup=2, chain=True):
+def _time_fn(fn, q, k, v, iters=20, warmup=2):
     """Median-free pipelined timing: the per-dispatch tunnel round-trip here
     is ~70 ms, far above kernel compute, so per-call sync timing measures the
     tunnel, not the chip.  Instead dispatch `iters` dependent calls (output

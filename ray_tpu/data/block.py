@@ -168,7 +168,20 @@ class BlockAccessor:
         if is_arrow_block(block):
             return block.nbytes
         if is_pandas_block(block):
-            return int(block.memory_usage(index=False, deep=True).sum())
+            # deep=True scans every object cell (O(n) strings); sample like
+            # the numpy-dict path below — metadata runs on the read path
+            total = 0
+            for c in block.columns:
+                col = block[c]
+                if col.dtype == object:
+                    n = len(col)
+                    head = col.iloc[:100]
+                    per = sum(64 + getattr(x, "nbytes", len(repr(x)))
+                              for x in head)
+                    total += per * max(1, n // max(1, min(n, 100)))
+                else:
+                    total += int(col.memory_usage(index=False, deep=False))
+            return total
         total = 0
         for v in block.values():
             if v.dtype.kind == "O":
@@ -208,7 +221,9 @@ class BlockAccessor:
         if is_arrow_block(block):
             return block.slice(start, max(end - start, 0))
         if is_pandas_block(block):
-            return block.iloc[start:end]
+            # reset: a UDF assigning a fresh RangeIndex series to a batch
+            # with index 5..9 would align-on-index into all-NaN
+            return block.iloc[start:end].reset_index(drop=True)
         return {k: v[start:end] for k, v in block.items()}
 
     @staticmethod
@@ -221,6 +236,15 @@ class BlockAccessor:
         if all(is_pandas_block(b) for b in blocks):
             import pandas as pd
 
+            first = set(blocks[0].columns)
+            for i, b in enumerate(blocks[1:], 1):
+                if set(b.columns) != first:
+                    # pd.concat would silently outer-join with NaN fill;
+                    # loud beats silent column loss (same rule as the dict
+                    # and arrow paths)
+                    raise ValueError(
+                        f"cannot concat blocks with mismatched columns: "
+                        f"{sorted(first)} vs {sorted(b.columns)} (block {i})")
             return pd.concat(list(blocks), ignore_index=True)
         if any(is_pandas_block(b) for b in blocks):
             blocks = [BlockAccessor.to_numpy_block(b)

@@ -387,3 +387,18 @@ def test_pandas_native_blocks(cluster):
     assert sorted(r["count()"] for r in agg) == [6, 7, 7]
     # to_pandas round-trip is the identity for frame blocks
     assert BlockAccessor.to_pandas(blk) is blk
+
+    # batched frames carry a zero-based index: a UDF assigning a fresh
+    # RangeIndex series must not align into NaN (the slice keeps no parent
+    # index)
+    def assign(batch):
+        import pandas as pd
+
+        batch = batch.copy()
+        batch["y"] = pd.Series(range(len(batch)))
+        assert not batch["y"].isna().any(), batch.index
+        return batch
+
+    rows = ray_tpu.data.from_pandas(df) \
+        .map_batches(assign, batch_size=6, batch_format="pandas").take_all()
+    assert all(r["y"] is not None and r["y"] == r["y"] for r in rows)
