@@ -119,6 +119,7 @@ _d("gcs_storage_path", str, "", "sqlite file for GCS persistence; empty = in-mem
 _d("gcs_reconnect_timeout_s", float, 60.0, "nodelets/workers retry the GCS connection this long")
 _d("gcs_restart_actor_grace_s", float, 10.0, "restarted GCS waits this long for nodes to re-report actors before declaring them failed")
 _d("task_max_retries_default", int, 3, "default retries for tasks (on worker/node death)")
+_d("max_lease_spillbacks", int, 4, "max times one lease request hops between nodelets before it must settle")
 _d("actor_max_restarts_default", int, 0, "default actor restarts")
 _d("lineage_enabled", bool, True, "enable lineage-based object recovery")
 _d("max_lineage_bytes", int, 256 * 1024**2, "lineage retention budget per owner")

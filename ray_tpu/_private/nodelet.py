@@ -1011,9 +1011,19 @@ class Nodelet:
             bundle, err = self._resolve_bundle(bundle, resources)
             if err is not None:
                 return {"type": "infeasible", "reason": err}
-        elif strategy.get("kind") not in ("node_affinity",) and spillback_count < 2:
-            target = self._pick_node(resources, strategy)
-            if target is None:
+        elif strategy.get("kind") not in ("node_affinity",):
+            # Spilled requests grant locally when they fit (no pointless
+            # extra hops: the sender already chose this node); they re-spill
+            # only while they DON'T fit here, up to a bounded chain
+            # (reference: grant_or_reject spillback leases,
+            # node_manager.cc:1794 — the cap replaces reject-and-retry;
+            # the previous hard `< 2` cap could also queue a spilled
+            # request forever on a node where it is locally infeasible).
+            local_fit = self._fits_local(resources, None)
+            consult = spillback_count == 0 or not local_fit
+            max_spill = RayConfig.max_lease_spillbacks
+            target = self._pick_node(resources, strategy) if consult else None
+            if consult and target is None:
                 if not self._feasible_local(resources):
                     # No node fits today — but the autoscaler may launch one:
                     # record the unmet shape as demand (deduped: retries come
@@ -1036,10 +1046,23 @@ class Nodelet:
                             now, dict(resources), warned)
                     return {"type": "retry", "delay": 1.0,
                             "reason": f"no node currently satisfies {resources}"}
-            elif target != self.node_id.binary():
+            elif target is not None and target != self.node_id.binary() \
+                    and spillback_count < max_spill:
                 view = self.cluster_view.get(target)
                 if view and view.get("addr"):
                     return {"type": "spillback", "node_addr": view["addr"]}
+            if not local_fit and not self._feasible_local(resources):
+                # end of the chain on a node that can NEVER run this shape:
+                # bounce to the client rather than queueing forever — and
+                # record the shape so demand-driven scale-up still sees it
+                now = time.monotonic()
+                shape = tuple(sorted(resources.items()))
+                prev = self._infeasible_demand.get(shape)
+                if len(self._infeasible_demand) < 256 or prev:
+                    self._infeasible_demand[shape] = (
+                        now, dict(resources), prev[2] if prev else 0.0)
+                return {"type": "retry", "delay": 1.0,
+                        "reason": f"node cannot ever satisfy {resources}"}
         token = msg.get("token")
         # Local grant (or queue until resources free up).  The pump ACQUIRES on
         # behalf of the waiter before waking it, so concurrent waiters can never

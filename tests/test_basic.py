@@ -248,3 +248,62 @@ class TestReturnedRefs:
         assert ray_tpu.get(c.collect.remote(), timeout=30)
         time.sleep(0.5)
         assert ray_tpu.get(inner, timeout=30) == list(range(100))
+
+
+def test_spilled_lease_never_queues_on_infeasible_node(ray_start_regular):
+    """A lease request that arrives pre-spilled at a node which can NEVER
+    satisfy it must bounce back ('retry'), not queue forever (the old
+    hard 2-hop cap skipped the feasibility check for spilled requests)."""
+    from ray_tpu._private.worker import require_core
+
+    core = require_core()
+    # the shared runtime's single node: ask for more CPU than it has
+    info = core.io.run(core.nodelet_conn.call("node_info", None))
+    too_big = {"CPU": float(info["resources_total"].get("CPU", 1)) + 64}
+
+    resp = core.io.run(core.nodelet_conn.call(
+        "request_worker_lease",
+        {"resources": too_big, "strategy": {"kind": "hybrid"},
+         "bundle": None, "spillback_count": 5, "token": "t-spill-test"},
+        timeout=30))
+    assert resp["type"] == "retry", resp
+
+
+def test_spill_chain_end_bounces_off_small_node():
+    """End-of-chain semantics: a request at its spillback cap, on a node too
+    small for it while a BIGGER node exists, bounces 'retry' (and records
+    demand) instead of queueing forever on the small node."""
+    from ray_tpu._private import rpc as _rpc
+    from ray_tpu._private.worker import require_core
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    try:
+        small = cluster.add_node(num_cpus=1)
+        ray_tpu.init(address=cluster.address)
+        cluster.add_node(num_cpus=8)
+        cluster.wait_for_nodes()
+        core = require_core()
+
+        async def ask():
+            conn = await _rpc.connect(*small.nodelet_addr,
+                                      name="test->small-nodelet")
+            try:
+                # CPU:4 fits the big node (so a spill target EXISTS) but the
+                # request is already at its hop cap -> must bounce, since
+                # this node can never run it
+                return await conn.call(
+                    "request_worker_lease",
+                    {"resources": {"CPU": 4.0},
+                     "strategy": {"kind": "hybrid"}, "bundle": None,
+                     "spillback_count": 99, "token": "t-chain-end"},
+                    timeout=30)
+            finally:
+                await conn.close()
+
+        resp = core.io.run(ask())
+        assert resp["type"] == "retry", resp
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
