@@ -1597,47 +1597,54 @@ class CoreWorker:
         try:
             args, _ = self._resolve_args(spec)
             cfg = args[0]
-            method = getattr(self.actor_instance, cfg["method"])
-            srcs: list = []
-            for kind, v in cfg["args"]:
-                if kind == "ch":
-                    ch = open_channel(v, "r")
-                    opened.append(ch)
-                    srcs.append(ch)
-                else:
-                    srcs.append((v,))  # constant, pre-wrapped
-            outs = [open_channel(n, "w") for n in cfg["out"]]
-            opened.extend(outs)
-            kwargs = cfg.get("kwargs") or {}
-            while True:
-                vals = []
-                closed = False
-                err = None
-                for src in srcs:
-                    if isinstance(src, tuple):
-                        vals.append(src[0])
-                        continue
-                    try:
-                        item = src.read()
-                    except ChannelClosed:
-                        closed = True
+            # one loop serves ALL of this actor's compiled nodes, in the
+            # topological order the compiler recorded
+            node_cfgs = cfg["nodes"] if "nodes" in cfg else [cfg]
+            plans = []
+            for nc in node_cfgs:
+                srcs: list = []
+                for kind, v in nc["args"]:
+                    if kind == "ch":
+                        ch = open_channel(v, "r")
+                        opened.append(ch)
+                        srcs.append(ch)
+                    else:
+                        srcs.append((v,))  # constant, pre-wrapped
+                node_outs = [open_channel(n, "w") for n in nc["out"]]
+                opened.extend(node_outs)
+                outs.extend(node_outs)
+                plans.append((getattr(self.actor_instance, nc["method"]),
+                              srcs, nc.get("kwargs") or {}, node_outs))
+            closed = False
+            while not closed:
+                for method, srcs, kwargs, node_outs in plans:
+                    vals = []
+                    err = None
+                    for src in srcs:
+                        if isinstance(src, tuple):
+                            vals.append(src[0])
+                            continue
+                        try:
+                            item = src.read()
+                        except ChannelClosed:
+                            closed = True
+                            break
+                        if isinstance(item, DagError) and err is None:
+                            err = item  # pass the upstream failure through
+                        vals.append(item)
+                    if closed:
                         break
-                    if isinstance(item, DagError) and err is None:
-                        err = item  # pass the upstream failure through
-                    vals.append(item)
-                if closed:
-                    break
-                if err is not None:
-                    res = err
-                else:
-                    try:
-                        res = method(*vals, **kwargs)
-                    except BaseException as e:
-                        res = DagError(e)
-                # one dumps per message, however many out edges
-                payload = pickle.dumps(res, protocol=5)
-                for o in outs:
-                    o.write_bytes(payload)
+                    if err is not None:
+                        res = err
+                    else:
+                        try:
+                            res = method(*vals, **kwargs)
+                        except BaseException as e:
+                            res = DagError(e)
+                    # one dumps per message, however many out edges
+                    payload = pickle.dumps(res, protocol=5)
+                    for o in node_outs:
+                        o.write_bytes(payload)
             return self._pack_returns(spec, None)
         except BaseException as e:
             return {"status": "error", "error": pickle.dumps(

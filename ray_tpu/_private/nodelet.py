@@ -893,6 +893,23 @@ class Nodelet:
         return True
 
     # ---------------------------------------------------------- lease broker
+    def _record_infeasible_demand(self, resources: Dict[str, float]) -> None:
+        """Dedupe one unmet resource shape into the demand view the
+        autoscaler reads, warning at most every 30 s per shape (retries come
+        every second and must look like one task, not N)."""
+        now = time.monotonic()
+        shape = tuple(sorted(resources.items()))
+        prev = self._infeasible_demand.get(shape)
+        warned = prev[2] if prev else 0.0
+        if now - warned > 30.0:
+            logger.warning(
+                "task requiring %s cannot be scheduled on any current "
+                "node; it stays pending (an autoscaler may add capacity)",
+                resources)
+            warned = now
+        if len(self._infeasible_demand) < 256 or prev:
+            self._infeasible_demand[shape] = (now, dict(resources), warned)
+
     def _fits_local(self, resources: Dict[str, float], bundle: Optional[Tuple[bytes, int]]) -> bool:
         if bundle is not None:
             b = self.bundles.get(tuple(bundle))
@@ -1026,24 +1043,11 @@ class Nodelet:
             if consult and target is None:
                 if not self._feasible_local(resources):
                     # No node fits today — but the autoscaler may launch one:
-                    # record the unmet shape as demand (deduped: retries come
-                    # every second and must not look like N tasks) and have
-                    # the submitter retry, keeping the task pending
-                    # (reference: infeasible tasks wait; ResourceLoad drives
-                    # scale-up, with periodic infeasible-task warnings).
-                    now = time.monotonic()
-                    shape = tuple(sorted(resources.items()))
-                    prev = self._infeasible_demand.get(shape)
-                    warned = prev[2] if prev else 0.0
-                    if now - warned > 30.0:
-                        logger.warning(
-                            "task requiring %s cannot be scheduled on any "
-                            "current node; it stays pending (an autoscaler "
-                            "may add capacity)", resources)
-                        warned = now
-                    if len(self._infeasible_demand) < 256 or prev:
-                        self._infeasible_demand[shape] = (
-                            now, dict(resources), warned)
+                    # record the unmet shape as demand and have the submitter
+                    # retry, keeping the task pending (reference: infeasible
+                    # tasks wait; ResourceLoad drives scale-up, with periodic
+                    # infeasible-task warnings).
+                    self._record_infeasible_demand(resources)
                     return {"type": "retry", "delay": 1.0,
                             "reason": f"no node currently satisfies {resources}"}
             elif target is not None and target != self.node_id.binary() \
@@ -1055,12 +1059,7 @@ class Nodelet:
                 # end of the chain on a node that can NEVER run this shape:
                 # bounce to the client rather than queueing forever — and
                 # record the shape so demand-driven scale-up still sees it
-                now = time.monotonic()
-                shape = tuple(sorted(resources.items()))
-                prev = self._infeasible_demand.get(shape)
-                if len(self._infeasible_demand) < 256 or prev:
-                    self._infeasible_demand[shape] = (
-                        now, dict(resources), prev[2] if prev else 0.0)
+                self._record_infeasible_demand(resources)
                 return {"type": "retry", "delay": 1.0,
                         "reason": f"node cannot ever satisfy {resources}"}
         token = msg.get("token")

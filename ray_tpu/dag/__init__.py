@@ -58,6 +58,14 @@ class DAGNode:
         fn = getattr(self._remote_fn, "_function", None)
         return getattr(fn, "__name__", "task")
 
+    def experimental_compile(self, max_buf: int = 1 << 20, depth: int = 2):
+        """Compile this graph into persistent channels + actor loops
+        (valid for actor-method graphs: ClassMethodNode/MultiOutputNode
+        roots — the compiler validates node kinds)."""
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self, max_buf=max_buf, depth=depth)
+
     def __repr__(self):
         return f"DAGNode({self.fn_name()})"
 
@@ -103,14 +111,33 @@ class ClassMethodNode(DAGNode):
         memo[key] = ref
         return ref
 
-    def experimental_compile(self, max_buf: int = 1 << 20, depth: int = 2):
-        """Compile this graph into persistent channels + actor loops."""
-        from ray_tpu.dag.compiled import CompiledDAG
-
-        return CompiledDAG(self, max_buf=max_buf, depth=depth)
 
     def fn_name(self) -> str:
         return self._actor_method._name
 
     def __repr__(self):
         return f"ClassMethodNode({self.fn_name()})"
+
+
+class MultiOutputNode(DAGNode):
+    """Bundle several graph leaves into one compiled output: ``execute()``
+    results arrive as a list, one element per member (reference:
+    dag/output_node.py MultiOutputNode)."""
+
+    def __init__(self, outputs: List[DAGNode]):
+        super().__init__(None, tuple(outputs), {})
+        if not outputs or not all(isinstance(o, ClassMethodNode)
+                                  for o in outputs):
+            raise ValueError("MultiOutputNode takes a non-empty list of "
+                             "actor-method nodes")
+        self.outputs = list(outputs)
+
+    def _submit(self, memo: Dict[int, Any]):
+        return [o._submit(memo) for o in self.outputs]
+
+
+    def fn_name(self) -> str:
+        return "MultiOutput"
+
+    def __repr__(self):
+        return f"MultiOutputNode({len(self.outputs)} outputs)"

@@ -12,8 +12,10 @@ run method -> write outputs) started as ONE ordinary actor task.  After
 compile, a hop costs one pickle + one memcpy + one ring-counter publish;
 no lease, no RPC frame, no event loop.
 
-Restrictions (mirroring the reference's v1): every non-input node is an
-actor-method call, one loop per actor, single output node.
+Restrictions: every non-input node is an actor-method call.  An actor may
+host SEVERAL nodes (its loop runs them in topological order each tick), and
+``MultiOutputNode`` roots return a list per execute (reference:
+dag/output_node.py).
 
 Edges are node-aware: when both endpoints live on the driver's node the edge
 is an shm ring; an edge that crosses nodes falls back to a TCP channel with
@@ -29,7 +31,8 @@ from __future__ import annotations
 import pickle
 from typing import Any, Dict, List, Optional
 
-from ray_tpu.dag import ClassMethodNode, DAGNode, InputNode
+from ray_tpu.dag import (ClassMethodNode, DAGNode, InputNode,
+                         MultiOutputNode)
 from ray_tpu.experimental.channel import (ChannelClosed, ShmChannel,
                                           TcpChannel)
 
@@ -64,22 +67,27 @@ class CompiledDAGRef:
 
     def get(self, timeout: Optional[float] = None) -> Any:
         value = self._dag._result_for(self._seq, timeout)
-        if isinstance(value, DagError):
-            value.raise_()
+        members = value if self._dag._is_multi else [value]
+        for v in members:  # multi-output: any member's failure raises
+            if isinstance(v, DagError):
+                v.raise_()
         return value
 
 
 class CompiledDAG:
-    def __init__(self, output_node: ClassMethodNode, max_buf: int = 1 << 20,
+    def __init__(self, output_node: DAGNode, max_buf: int = 1 << 20,
                  depth: int = 2):
         self._output = output_node
         self._max_buf = max_buf
         self._depth = depth
         self._nodes: List[ClassMethodNode] = []
         self._input: Optional[InputNode] = None
-        self._channels: List[ShmChannel] = []
-        self._input_channels: List[ShmChannel] = []
-        self._out_channel: Optional[ShmChannel] = None
+        self._channels: List[Any] = []
+        self._input_channels: List[Any] = []
+        self._out_channels: List[Any] = []
+        self._final_descs: List[Any] = []
+        self._partial: List[Any] = []  # mid-row reads surviving a timeout
+        self._is_multi = False
         self._loop_refs = []
         import uuid
 
@@ -110,6 +118,13 @@ class CompiledDAG:
                     raise ValueError("compiled DAGs take exactly one InputNode")
                 self._input = node
                 return
+            if isinstance(node, MultiOutputNode):
+                if node is not self._output:
+                    raise ValueError("MultiOutputNode may only be the "
+                                     "compiled graph's root")
+                for up in node.outputs:
+                    visit(up)
+                return
             if not isinstance(node, ClassMethodNode):
                 raise ValueError(
                     "compiled DAGs support actor-method nodes only; "
@@ -122,11 +137,12 @@ class CompiledDAG:
         if self._input is None:
             raise ValueError("compiled DAG needs an InputNode")
         self._nodes = order
+        self._is_multi = isinstance(self._output, MultiOutputNode)
+        self._output_members: List[ClassMethodNode] = (
+            list(self._output.outputs) if self._is_multi else [self._output])
         actors = set()
         for n in order:
             aid = n._actor_method._handle._actor_id
-            if aid in actors:
-                raise ValueError("one compiled node per actor (v1 restriction)")
             if aid in _ACTORS_IN_USE:
                 raise ValueError(
                     f"actor {aid.hex()[:8]} already serves a live compiled "
@@ -218,25 +234,35 @@ class CompiledDAG:
                 "args": arg_sources,
                 "kwargs": dict(n._bound_kwargs),
             }
-        # the output node feeds the driver
-        final_desc, final_ch = new_edge(node_of(self._output), driver_node)
-        out_edges[id(self._output)].append(final_desc)
-        self._final_desc = final_desc
-        self._out_channel = final_ch  # None for tcp: opened after loops start
+        # each output member feeds the driver on its own edge
+        self._final_descs: List[Any] = []
+        self._out_channels: List[Any] = []  # None entries: tcp, opened lazily
+        for member in self._output_members:
+            final_desc, final_ch = new_edge(node_of(member), driver_node)
+            out_edges[id(member)].append(final_desc)
+            self._final_descs.append(final_desc)
+            self._out_channels.append(final_ch)
         self._input_channels = input_edges
 
-        # start one loop per actor (a plain actor task that holds the actor
-        # until teardown closes its input channels)
+        # ONE loop per actor serving all of that actor's nodes in global
+        # topological order (multiple bound methods on one actor are legal;
+        # channel depth buffers same-actor node-to-node edges)
         from ray_tpu.actor import ActorMethod
 
+        per_actor: Dict[Any, dict] = {}
         for n in order:
             cfg = node_cfg[id(n)]
             cfg["out"] = list(out_edges[id(n)])
+            aid = n._actor_method._handle._actor_id
+            entry = per_actor.setdefault(
+                aid, {"handle": n._actor_method._handle, "nodes": []})
+            entry["nodes"].append(cfg)
+        for entry in per_actor.values():
             # reserved method: handled by the worker runtime, so it is not
             # in the user class's method table
-            loop_method = ActorMethod(n._actor_method._handle,
-                                      CHANNEL_LOOP_METHOD)
-            self._loop_refs.append(loop_method.remote(cfg))
+            loop_method = ActorMethod(entry["handle"], CHANNEL_LOOP_METHOD)
+            self._loop_refs.append(
+                loop_method.remote({"nodes": entry["nodes"]}))
         _ACTORS_IN_USE.update(self._actor_ids)
 
     # ------------------------------------------------------------ execute
@@ -245,10 +271,10 @@ class CompiledDAG:
         if self._torn_down:
             raise RuntimeError("compiled DAG was torn down")
         payload = pickle.dumps(value, protocol=5)
-        # Connect the (possibly TCP) output edge NOW: a driver that executes
+        # Connect the (possibly TCP) output edges NOW: a driver that executes
         # and then delays its first get() past the producer's accept timeout
         # would otherwise kill the edge while the result waits to be written.
-        self._ensure_out_channel()
+        self._ensure_out_channels()
         # Wait for room on EVERY input channel before writing any: a partial
         # write followed by a timeout would desynchronize multi-input DAGs
         # for all later executes.
@@ -260,28 +286,37 @@ class CompiledDAG:
         self._seq += 1
         return ref
 
-    def _ensure_out_channel(self):
-        """The final edge's driver endpoint: eager for shm; for a tcp edge
+    def _ensure_out_channels(self):
+        """Each final edge's driver endpoint: eager for shm; for a tcp edge
         the producer actor registers the rendezvous when its loop starts, so
-        the driver connects lazily here (first result fetch)."""
-        if self._out_channel is None:
-            ch = TcpChannel(self._final_desc[1], role="r",
-                            depth=self._depth)
-            self._channels.append(ch)
-            self._out_channel = ch
-        return self._out_channel
+        the driver connects lazily here (first execute/result fetch)."""
+        for i, ch in enumerate(self._out_channels):
+            if ch is None:
+                ch = TcpChannel(self._final_descs[i][1], role="r",
+                                depth=self._depth)
+                self._channels.append(ch)
+                self._out_channels[i] = ch
+        return self._out_channels
 
     def _result_for(self, seq: int, timeout: Optional[float]) -> Any:
         """Results arrive in execute order (the graph is static): read
-        forward, buffering values for refs fetched out of order."""
-        self._ensure_out_channel()
+        forward, buffering values for refs fetched out of order.  A
+        MultiOutputNode graph yields a list, one element per member."""
+        outs = self._ensure_out_channels()
         if seq <= self._drained and seq not in self._results:
             raise RuntimeError(
                 f"result for execute #{seq} was already consumed")
         while seq not in self._results:
-            value = self._out_channel.read(timeout)
+            # A timeout partway through a multi-member row must not
+            # desynchronize members: partially-read values persist in
+            # self._partial so the retry resumes at the channel that
+            # timed out (the single-channel read was atomic; this keeps
+            # the multi-channel row atomic too).
+            while len(self._partial) < len(outs):
+                self._partial.append(outs[len(self._partial)].read(timeout))
+            row, self._partial = self._partial, []
             self._drained += 1
-            self._results[self._drained] = value
+            self._results[self._drained] = row if self._is_multi else row[0]
         return self._results.pop(seq)
 
     # ------------------------------------------------------------ teardown

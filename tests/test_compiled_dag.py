@@ -212,3 +212,48 @@ def test_compiled_cross_node_pipeline():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_compiled_multi_output_and_shared_actor(cluster):
+    """MultiOutputNode roots return a list per execute, and one actor may
+    host several compiled nodes (its loop runs them in topo order) —
+    the reference's output_node.py + multi-method graphs."""
+    from ray_tpu.dag import MultiOutputNode
+
+    a = _Stage.options(num_cpus=0.1).remote(1)
+    b = _Stage.options(num_cpus=0.1).remote(10)
+    with InputNode() as inp:
+        first = a.add.bind(inp)        # x+1     (actor a)
+        left = b.add.bind(first)       # x+11    (actor b)
+        right = a.add.bind(left)       # x+12    (actor a AGAIN: 2 nodes)
+        dag = MultiOutputNode([left, right])
+    compiled = dag.experimental_compile()
+    try:
+        for i in range(6):
+            out = compiled.execute(i).get(timeout=60)
+            assert out == [i + 11, i + 12], out
+    finally:
+        compiled.teardown()
+    # actors are serviceable again after teardown
+    assert ray_tpu.get(a.add.remote(1), timeout=60) == 2
+    for h in (a, b):
+        ray_tpu.kill(h)
+
+
+def test_compiled_multi_output_error_propagates(cluster):
+    from ray_tpu.dag import MultiOutputNode
+
+    a = _Stage.options(num_cpus=0.1).remote(1)
+    b = _Stage.options(num_cpus=0.1).remote(2)
+    with InputNode() as inp:
+        ok = a.add.bind(inp)
+        bad = b.boom.bind(inp)
+        dag = MultiOutputNode([ok, bad])
+    compiled = dag.experimental_compile()
+    try:
+        with pytest.raises(ValueError, match="stage exploded"):
+            compiled.execute(1).get(timeout=60)
+    finally:
+        compiled.teardown()
+    for h in (a, b):
+        ray_tpu.kill(h)
