@@ -284,14 +284,19 @@ class CoreWorker:
         }
         if error:
             ev["error"] = error[:500]
+        self.emit_raw_event(ev, terminal=state in ("FINISHED", "FAILED"))
+
+    def emit_raw_event(self, ev: dict, *, terminal: bool = False) -> None:
+        """Append one pre-built event (task lifecycle or user span) to the
+        buffer; terminal events flush eagerly — a worker reused for the next
+        task may be killed by it before the periodic tick, losing this
+        task's whole lifecycle from the state API.  One pending flush is
+        enough: under a burst of completions the first drain takes
+        everything queued behind it."""
+        if not RayConfig.task_events_enabled:
+            return
         self._task_events.append(ev)
-        if state in ("FINISHED", "FAILED") and not self._flush_scheduled:
-            # Terminal events flush eagerly: a worker reused for the next task
-            # may be killed by it before the periodic tick, losing this task's
-            # whole lifecycle from the state API.  One pending flush is
-            # enough — under a burst of completions the first drain takes
-            # everything queued behind it (a spawn per task costs a
-            # cross-thread wakeup each).
+        if terminal and not self._flush_scheduled:
             self._flush_scheduled = True
             self.io.spawn(self._flush_task_events_once())
 

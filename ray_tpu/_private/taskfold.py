@@ -41,7 +41,7 @@ def fold_task_events(events, limit: int = 1000,
         })
         row["state_ts"][ev["state"]] = ev["ts"]
         row["state"] = ev["state"]
-        for k in ("node_id", "worker_id", "pid", "error"):
+        for k in ("node_id", "worker_id", "pid", "error", "attributes"):
             if ev.get(k) is not None:
                 row[k] = ev[k]
     return list(rows.values())[-limit:]

@@ -1,0 +1,49 @@
+"""joblib backend over the task runtime (reference: util/joblib)."""
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster():
+    from conftest import ensure_shared_runtime
+
+    yield ensure_shared_runtime()
+
+
+def _sq(x):
+    import os
+
+    return x * x, os.getpid()
+
+
+def test_joblib_parallel_over_cluster(cluster):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_config(backend="ray_tpu", n_jobs=4):
+        out = joblib.Parallel()(joblib.delayed(_sq)(i) for i in range(12))
+    vals = [v for v, _pid in out]
+    assert vals == [i * i for i in range(12)]
+    # batches actually left this process
+    import os
+
+    pids = {pid for _v, pid in out}
+    assert os.getpid() not in pids
+    assert pids, "no worker pids recorded"
+
+
+def _explode(x):
+    raise ValueError(f"boom-{x}")
+
+
+def test_joblib_error_propagates(cluster):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray
+
+    register_ray()
+    with joblib.parallel_config(backend="ray_tpu", n_jobs=2):
+        with pytest.raises(Exception, match="boom"):
+            joblib.Parallel()(joblib.delayed(_explode)(i) for i in range(3))

@@ -1,0 +1,78 @@
+"""User spans + OTLP export (reference: util/tracing/tracing_helper.py)."""
+
+import json
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def cluster():
+    from conftest import ensure_shared_runtime
+
+    yield ensure_shared_runtime()
+
+
+def test_trace_span_parents_tasks_and_exports_otlp(cluster, tmp_path):
+    import time
+
+    from ray_tpu.util import state
+    from ray_tpu.util.tracing import export_otlp, trace_span
+
+    @ray_tpu.remote
+    def traced_child(x):
+        return x * 2
+
+    with trace_span("my-pipeline", {"rows": 7}) as span:
+        assert span.trace_id and span.span_id
+        out = ray_tpu.get(traced_child.remote(21), timeout=60)
+        assert out == 42
+        span.set_attribute("result", out)
+        tid = span.trace_id
+
+    # the span + the child task land in the same trace, parent-linked
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        spans = state.get_trace(tid)
+        names = {s["name"].rsplit(".", 1)[-1] for s in spans}
+        if {"my-pipeline", "traced_child"} <= names and all(
+                s["end"] is not None for s in spans
+                if s["name"].rsplit(".", 1)[-1] in ("my-pipeline", "traced_child")):
+            break
+        time.sleep(0.3)
+    spans = state.get_trace(tid)
+    by_name = {s["name"].rsplit(".", 1)[-1]: s for s in spans}
+    assert "my-pipeline" in by_name and "traced_child" in by_name, by_name
+    assert by_name["traced_child"]["parent_span_id"] == \
+        by_name["my-pipeline"]["span_id"]
+
+    # OTLP/JSON export: valid shape, both spans, attributes carried
+    path = tmp_path / "trace.json"
+    n = export_otlp(str(path), trace_id=tid)
+    assert n >= 2
+    doc = json.loads(path.read_text())
+    otlp_spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert all(s["traceId"] == tid for s in otlp_spans)
+    mine = next(s for s in otlp_spans if s["name"] == "my-pipeline")
+    keys = {a["key"] for a in mine["attributes"]}
+    assert {"rows", "result"} <= keys, keys
+    child = next(s for s in otlp_spans if s["name"].endswith("traced_child"))
+    assert child["parentSpanId"] == mine["spanId"]
+    assert int(mine["endTimeUnixNano"]) >= int(mine["startTimeUnixNano"])
+
+
+def test_trace_span_failure_status(cluster, tmp_path):
+    from ray_tpu.util.tracing import export_otlp, trace_span
+
+    with pytest.raises(RuntimeError):
+        with trace_span("exploding") as span:
+            tid = span.trace_id
+            raise RuntimeError("kaboom")
+    path = tmp_path / "fail.json"
+    assert export_otlp(str(path), trace_id=tid) >= 1
+    doc = json.loads(path.read_text())
+    spans = doc["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    bad = next(s for s in spans if s["name"] == "exploding")
+    assert bad["status"]["code"] == 2
+    assert "kaboom" in bad["status"]["message"]
