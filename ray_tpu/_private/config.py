@@ -132,6 +132,9 @@ _d("memory_usage_threshold", float, 0.95, "kill a retriable worker above this no
 _d("event_stats", bool, True, "record per-handler event-loop stats")
 _d("metrics_report_interval_ms", int, 5_000, "metrics push period")
 _d("task_events_enabled", bool, True, "buffer + flush task lifecycle events to GCS")
+_d("local_fs_capacity_threshold", float, 0.95, "nodelet stops taking leases when the session filesystem is this full")
+_d("fs_monitor_interval_s", float, 2.0, "disk-usage check cadence")
+_d("test_hooks", bool, False, "enable fault-injection RPCs (set_env); never on in production")
 _d("task_events_flush_interval_ms", int, 1_000, "task event flush period")
 _d("task_events_max_buffer_size", int, 10_000, "drop task events beyond this")
 

@@ -114,6 +114,7 @@ class CoreWorker:
         self._worker_id_hex = self.worker_id.hex()
         self._node_id_hex = node_id.hex() if node_id else None
         self._pid = os.getpid()
+        self._race_guard = None  # set when the race detector wraps an actor
         self.session_dir = session_dir
         self.namespace = namespace
         self.job_id = JobID.from_int(0)
@@ -1713,6 +1714,15 @@ class CoreWorker:
             _trace_ctx.reset(trace_token)
         self.actor_id = spec.actor_creation_id
         self.job_id = spec.job_id
+        if spec.max_concurrency > 1:
+            from ray_tpu._private import race_detector
+
+            if race_detector.enabled():
+                # sanitizer: catch unsynchronized concurrent writes to
+                # actor state under threaded execution (SURVEY §5.2)
+                self.actor_instance = race_detector.wrap_instance(
+                    self.actor_instance)
+                self._race_guard = race_detector._MethodGuard
         if spec.max_concurrency > 1 or _has_async_methods(type(self.actor_instance)):
             # Async actors default to high concurrency (reference: actor.py —
             # async actors get max_concurrency=1000 unless set explicitly).
@@ -1738,7 +1748,12 @@ class CoreWorker:
             # (leased task workers, save/restore) or permanently at actor
             # creation (dedicated workers).
             args, kwargs = self._resolve_args(spec)
-            out = fn(*args, **kwargs)
+            if self._race_guard is not None and self.actor_instance is not None:
+                with self._race_guard(self.actor_instance,
+                                      spec.actor_method_name or spec.name):
+                    out = fn(*args, **kwargs)
+            else:
+                out = fn(*args, **kwargs)
             return self._pack_returns(spec, out)
         except BaseException as e:
             return {"status": "error",

@@ -159,6 +159,7 @@ class Nodelet:
         self._bg: List[asyncio.Task] = []
         self._shutting_down = False
         self._gcs_reconnecting = False
+        self._disk_full = False
 
     # ------------------------------------------------------------------ boot
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
@@ -178,6 +179,7 @@ class Nodelet:
         self._bg.append(asyncio.get_event_loop().create_task(self._report_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._monitor_workers_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._flush_dir_loop()))
+        self._bg.append(asyncio.get_event_loop().create_task(self._fs_monitor_loop()))
         logger.info("nodelet %s on %s:%s resources=%s",
                     self.node_id.hex()[:8], *self.addr, self.resources_total)
         return self.addr
@@ -416,6 +418,46 @@ class Nodelet:
 
     async def rpc_get_metrics_text(self, conn, msg):
         return self.metrics_registry.prometheus_text()
+
+    # --------------------------------------------------------- disk monitor
+    def _disk_usage_fraction(self) -> Optional[float]:
+        """Fraction of the session-dir filesystem in use (test hook:
+        RAY_TPU_FAKE_DISK_USAGE)."""
+        fake = os.environ.get("RAY_TPU_FAKE_DISK_USAGE")
+        if fake:
+            try:
+                return float(fake)
+            except ValueError:
+                pass
+        try:
+            st = os.statvfs(self.session_dir)
+        except OSError:
+            return None
+        total = st.f_blocks * st.f_frsize
+        if total <= 0:
+            return None
+        return 1.0 - (st.f_bavail * st.f_frsize) / total
+
+    async def _fs_monitor_loop(self):
+        """Reject new work while the local filesystem is nearly full
+        (reference: _private/utils FileSystemMonitor + raylet's
+        over-capacity rejection): a full disk fails spills, log writes, and
+        runtime-env installs in ways that masquerade as unrelated bugs —
+        better to stop taking leases and say why."""
+        while True:
+            frac = self._disk_usage_fraction()
+            threshold = RayConfig.local_fs_capacity_threshold
+            over = frac is not None and frac >= threshold
+            if over and not self._disk_full:
+                logger.warning(
+                    "local filesystem is %.1f%% full (threshold %.0f%%): "
+                    "this node stops accepting new leases until space "
+                    "frees up", frac * 100, threshold * 100)
+            elif self._disk_full and not over:
+                logger.info("local filesystem back under the capacity "
+                            "threshold; accepting leases again")
+            self._disk_full = over
+            await asyncio.sleep(RayConfig.fs_monitor_interval_s)
 
     # ------------------------------------------------------------- log files
     def _log_dir(self) -> str:
@@ -1024,6 +1066,23 @@ class Nodelet:
         strategy = msg.get("strategy", {})
         bundle = msg.get("bundle")
         spillback_count = msg.get("spillback_count", 0)
+        if self._disk_full:
+            # a nearly-full local filesystem fails spills/logs/runtime-envs
+            # in confusing ways — push work AWAY: spill to a healthy node
+            # when one exists, bounce a retry otherwise (reference:
+            # FileSystemMonitor over-capacity rejection).  A plain retry
+            # here would pin the task to this node forever: the client's
+            # retry path re-picks its preferred node.
+            if bundle is None and strategy.get("kind") != "node_affinity":
+                target = self._pick_node(resources, strategy)
+                if target is not None and target != self.node_id.binary():
+                    view = self.cluster_view.get(target)
+                    if view and view.get("addr"):
+                        return {"type": "spillback",
+                                "node_addr": view["addr"]}
+            return {"type": "retry", "delay": 2.0,
+                    "reason": "node local filesystem is over the capacity "
+                              "threshold"}
         if bundle is not None:
             bundle, err = self._resolve_bundle(bundle, resources)
             if err is not None:
@@ -1140,6 +1199,20 @@ class Nodelet:
         self._release_lease(msg["lease_id"])
         return True
 
+    async def rpc_set_env(self, conn, msg):
+        """Fault-injection hook for chaos tests (fake disk usage, fake
+        memory pressure): set/clear an env var in THIS nodelet process.
+        DISABLED unless RayConfig.test_hooks — an open env-set RPC would
+        hand code execution (LD_PRELOAD/PYTHONPATH into spawned workers)
+        to anything that can reach the nodelet port."""
+        if not RayConfig.test_hooks:
+            raise PermissionError("set_env requires RAY_TPU_TEST_HOOKS=1")
+        if msg.get("value"):
+            os.environ[msg["key"]] = msg["value"]
+        else:
+            os.environ.pop(msg["key"], None)
+        return True
+
     # ------------------------------------------------------------ actor leases
     async def rpc_lease_worker_for_actor(self, conn, msg):
         """GCS asks this node to host an actor: lease a dedicated worker and run
@@ -1147,6 +1220,11 @@ class Nodelet:
         import pickle
 
         spec = pickle.loads(msg["spec"])
+        if self._disk_full:
+            # same capacity guard as task leases: a full disk breaks the
+            # actor's runtime-env install and log writes
+            return {"ok": False, "reason": "node local filesystem is over "
+                                           "the capacity threshold"}
         bundle = msg.get("bundle")
         if bundle is not None:
             bundle, err = self._resolve_bundle(bundle, spec.resources)

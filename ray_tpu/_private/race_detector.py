@@ -1,0 +1,153 @@
+"""Actor-state race detector (opt-in sanitizer).
+
+Counterpart of the reference's sanitizer story (SURVEY §5.2 — the reference
+relies on TSAN/ASAN builds of its C++ core).  This framework's shared
+mutable state lives in ACTORS, so the TPU-native equivalent is a dynamic
+sanitizer for the actor model: with ``RAY_TPU_RACE_DETECTOR=1`` (or
+``RayConfig.race_detector``), every actor running with
+``max_concurrency > 1`` gets its instance wrapped so that
+
+- each executing method registers in an in-flight table, and
+- every instance-attribute WRITE checks whether a *different* method
+  invocation is concurrently executing on another thread.
+
+An overlapping write is the shape of an unsynchronized actor-state race
+(two threads mutating `self` without a lock); the detector records it
+(attribute, both method names, thread ids) and logs a warning with the
+writing stack.  Reads are not tracked.
+
+CONSERVATIVE BY DESIGN: the detector sees method overlap, not lock
+ownership — a write correctly guarded by the user's own ``threading.Lock``
+is still reported as a POSSIBLE race (TSAN-grade lockset tracking would
+need to instrument every lock).  Suppress known-synchronized attributes
+with :func:`suppress` ("ClassName.attr") or the
+``RAY_TPU_RACE_DETECTOR_ALLOW`` env var (comma-separated).
+
+Reports are queryable in-process via :func:`get_reports` and surface in
+the actor's worker log.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import traceback
+from typing import Any, Dict, List
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_inflight: Dict[int, Dict[int, str]] = {}   # id(instance) -> {thread_id: method}
+_reports: List[Dict[str, Any]] = []
+_MAX_REPORTS = 256
+
+
+def enabled() -> bool:
+    from ray_tpu._private.config import RayConfig
+
+    env = os.environ.get("RAY_TPU_RACE_DETECTOR")
+    if env is not None:
+        return env.strip().lower() not in ("", "0", "false", "no", "off")
+    return bool(getattr(RayConfig, "race_detector", False))
+
+
+_suppressed: set = set()
+
+
+def suppress(class_attr: str) -> None:
+    """Mark ``"ClassName.attr"`` as known-synchronized (user holds a lock)."""
+    with _lock:
+        _suppressed.add(class_attr)
+
+
+def _suppressed_set() -> set:
+    env = os.environ.get("RAY_TPU_RACE_DETECTOR_ALLOW", "")
+    out = {s.strip() for s in env.split(",") if s.strip()}
+    with _lock:
+        return out | _suppressed
+
+
+def get_reports() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_reports)
+
+
+def clear_reports() -> None:
+    with _lock:
+        _reports.clear()
+
+
+class _MethodGuard:
+    """Context manager registering one executing method invocation."""
+
+    def __init__(self, instance: Any, method_name: str):
+        self._key = id(instance)
+        self._method = method_name
+
+    def __enter__(self):
+        with _lock:
+            _inflight.setdefault(self._key, {})[
+                threading.get_ident()] = self._method
+        return self
+
+    def __exit__(self, *exc):
+        with _lock:
+            tbl = _inflight.get(self._key)
+            if tbl is not None:
+                tbl.pop(threading.get_ident(), None)
+                if not tbl:
+                    _inflight.pop(self._key, None)
+        return False
+
+
+def _record(instance, attr: str, writer_method: str, others: Dict[int, str]):
+    cls_name = type(instance).__name__.replace("(race-checked)", "")
+    if f"{cls_name}.{attr}" in _suppressed_set():
+        return
+    report = {
+        "class": cls_name,
+        "attribute": attr,
+        "writer": writer_method,
+        "writer_thread": threading.get_ident(),
+        "concurrent": dict(others),
+        "stack": "".join(traceback.format_stack(limit=8)),
+    }
+    with _lock:
+        if len(_reports) < _MAX_REPORTS:
+            _reports.append(report)
+    logger.warning(
+        "POSSIBLE RACE: actor %s attribute %r written by %r while %s "
+        "executed concurrently on other threads.  If this write is guarded "
+        "by your own lock, suppress it: race_detector.suppress(%r) or "
+        "RAY_TPU_RACE_DETECTOR_ALLOW=%s",
+        report["class"], attr, writer_method,
+        sorted(set(others.values())),
+        f"{cls_name}.{attr}", f"{cls_name}.{attr}")
+
+
+def wrap_instance(instance: Any) -> Any:
+    """Return an instance whose attribute writes are race-checked: a dynamic
+    subclass overriding ``__setattr__`` (the original class is untouched —
+    other instances stay unwrapped)."""
+    cls = type(instance)
+
+    def __setattr__(self, name, value):  # noqa: N807
+        me = threading.get_ident()
+        with _lock:
+            tbl = dict(_inflight.get(id(self), {}))
+        my_method = tbl.pop(me, None)
+        if tbl:  # other method invocations are in flight on other threads
+            _record(self, name, my_method or "<constructor>", tbl)
+        cls.__setattr__(self, name, value)  # original class's semantics
+
+    try:
+        sanitized = type(f"{cls.__name__}(race-checked)", (cls,),
+                         {"__setattr__": __setattr__})
+        instance.__class__ = sanitized
+    except TypeError:
+        # classes with __slots__/exotic layouts can't be re-classed;
+        # sanitize is best-effort by design
+        logger.info("race detector cannot wrap %s (incompatible layout)",
+                    cls.__name__)
+    return instance

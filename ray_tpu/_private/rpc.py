@@ -23,7 +23,9 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import os
 import pickle
+import sys
 import threading
 import time
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
@@ -402,14 +404,63 @@ class EventLoopThread:
     happens here.
     """
 
-    def __init__(self, name: str = "ray-tpu-io"):
+    def __init__(self, name: str = "ray-tpu-io",
+                 stall_threshold_s: Optional[float] = None):
         self.loop = asyncio.new_event_loop()
+        self.name = name
+        self._beat = time.monotonic()
+        self._stall_logged = 0.0
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._thread.start()
+        if stall_threshold_s is None:
+            try:
+                stall_threshold_s = float(
+                    os.environ.get("RAY_TPU_LOOP_STALL_THRESHOLD_S", "5"))
+            except ValueError:
+                stall_threshold_s = 5.0  # a bad knob must not kill startup
+        if stall_threshold_s > 0:
+            self._start_stall_detector(stall_threshold_s)
 
     def _run(self):
         asyncio.set_event_loop(self.loop)
         self.loop.run_forever()
+
+    # ---------------------------------------------------- stall detection
+    def _start_stall_detector(self, threshold_s: float) -> None:
+        """Watchdog for the 'one slow handler starves every connection'
+        class of bug (reference: the instrumented asio event loop's
+        event_stats + stall warnings, src/ray/common/asio/).  A heartbeat
+        callback stamps the loop's liveness; a daemon thread warns — with
+        the loop thread's current stack — whenever the stamp goes stale."""
+        import traceback
+
+        def beat():
+            self._beat = time.monotonic()
+            if not self.loop.is_closed():
+                self.loop.call_later(min(threshold_s / 4, 1.0), beat)
+
+        try:
+            self.loop.call_soon_threadsafe(beat)
+        except RuntimeError:
+            return
+
+        def watch():
+            while self._thread.is_alive() and not self.loop.is_closed():
+                time.sleep(threshold_s / 2)
+                stalled = time.monotonic() - self._beat
+                if stalled > threshold_s and \
+                        time.monotonic() - self._stall_logged > 30.0:
+                    self._stall_logged = time.monotonic()
+                    frame = sys._current_frames().get(self._thread.ident)
+                    where = "".join(traceback.format_stack(frame)) \
+                        if frame is not None else "<no frame>"
+                    logger.warning(
+                        "event loop %r stalled for %.1fs — a handler is "
+                        "blocking the IO thread; current stack:\n%s",
+                        self.name, stalled, where)
+
+        threading.Thread(target=watch, name=f"{self.name}-stall-watch",
+                         daemon=True).start()
 
     def run(self, coro, timeout: Optional[float] = None):
         """Run a coroutine on the loop, blocking the calling thread."""

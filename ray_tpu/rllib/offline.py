@@ -1,0 +1,229 @@
+"""Offline RL: dataset recording + BC / MARWIL training from logged episodes.
+
+Counterpart of the reference's offline stack (reference: rllib/offline/ —
+dataset readers feeding Learners; rllib/algorithms/marwil/marwil.py MARWIL
+with BC as its beta=0 special case, rllib/algorithms/bc/bc.py).  TPU-first
+shape: episodes are recorded through ``ray_tpu.data`` (JSON blocks), the
+whole dataset lives in device memory as dense arrays, and each training
+iteration is ONE jitted scan over minibatches — no per-row Python.
+
+MARWIL loss (Wang et al. 2018, exponentially weighted imitation):
+
+    L = -E[ exp(beta * A / c) * log pi(a|s) ] + vf_coef * E[(V(s) - R)^2]
+
+with A = R - V(s) (advantage against the learned value baseline), c a
+running norm of |A|, and R the dataset's discounted return-to-go.
+beta = 0 recovers plain behavior cloning (the value head still trains, but
+the policy term ignores it).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import DiscretePolicyModule
+
+
+# ---------------------------------------------------------------- recording
+
+def record_dataset(path: str, env_name: str, n_episodes: int,
+                   policy_fn: Optional[Callable] = None, seed: int = 0,
+                   gamma: float = 0.99) -> Dict[str, Any]:
+    """Roll out ``policy_fn(obs) -> actions`` (default: a decent CartPole
+    heuristic so the data carries signal) and write one JSON row per step:
+    ``{"obs", "action", "return_to_go"}`` (reference: offline output_config
+    JSON episode writers).  Returns summary stats."""
+    from ray_tpu import data as rt_data
+    from ray_tpu.rllib.env import make_vector_env
+
+    env = make_vector_env(env_name, 1, seed=seed)
+
+    if policy_fn is None:
+        def policy_fn(obs):  # lean-direction heuristic, ~mean return 40+
+            return (obs[:, 2] + 0.5 * obs[:, 3] > 0).astype(np.int64)
+
+    rows = []
+    returns = []
+    for _ in range(n_episodes):
+        obs = env.reset()
+        ep: list = []
+        while True:
+            a = policy_fn(obs)
+            nxt, r, term, trunc, info = env.step(a)
+            ep.append((obs[0].tolist(), int(a[0]), float(r[0])))
+            obs = nxt
+            if bool(term[0] or trunc[0]):
+                break
+        # discounted return-to-go per step
+        g = 0.0
+        rtg = [0.0] * len(ep)
+        for i in range(len(ep) - 1, -1, -1):
+            g = ep[i][2] + gamma * g
+            rtg[i] = g
+        returns.append(sum(r for _, _, r in ep))
+        rows.extend({"obs": o, "action": a, "return_to_go": rt}
+                    for (o, a, _), rt in zip(ep, rtg))
+    rt_data.from_items(rows).write_json(path)
+    return {"episodes": n_episodes, "steps": len(rows),
+            "mean_return": float(np.mean(returns))}
+
+
+# ----------------------------------------------------------------- learning
+
+def _marwil_update(module, tx, params, opt_state, norm, batch, *,
+                   beta: float, vf_coef: float, minibatch: int):
+    import jax
+    import jax.numpy as jnp
+
+    n = batch["obs"].shape[0]
+    n_mb = max(n // minibatch, 1)
+    usable = n_mb * minibatch
+    mbs = {k: v[:usable].reshape((n_mb, minibatch) + v.shape[1:])
+           for k, v in batch.items()}
+
+    def loss_fn(p, norm, mb):
+        logp, _ent = module.logp_entropy(p, mb["obs"], mb["action"])
+        v = module.value(p, mb["obs"])
+        adv = mb["return_to_go"] - v
+        # running norm of |A| keeps exp() in range (reference: MARWIL's
+        # moving average of the squared advantage)
+        norm_new = 0.99 * norm + 0.01 * jnp.mean(jnp.abs(
+            jax.lax.stop_gradient(adv)))
+        w = jnp.exp(jnp.clip(
+            beta * jax.lax.stop_gradient(adv) / jnp.maximum(norm_new, 1e-3),
+            -10.0, 10.0))
+        pi_loss = -jnp.mean(w * logp)
+        vf_loss = jnp.mean(adv ** 2)
+        return pi_loss + vf_coef * vf_loss, (norm_new, pi_loss, vf_loss)
+
+    def body(carry, mb):
+        params, opt_state, norm = carry
+        (_, (norm, pi_l, vf_l)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, norm, mb)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda a, b: a + b, params, updates)
+        return (params, opt_state, norm), (pi_l, vf_l)
+
+    (params, opt_state, norm), (pi_ls, vf_ls) = jax.lax.scan(
+        body, (params, opt_state, norm), mbs)
+    return params, opt_state, norm, jnp.mean(pi_ls), jnp.mean(vf_ls)
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.input_path: Optional[str] = None
+        self.training_params = {
+            "lr": 3e-4,
+            "beta": 1.0,
+            "vf_coef": 1.0,
+            "grad_clip": 10.0,
+            "train_batch_size": 2048,
+            "minibatch_size": 256,
+        }
+
+    def offline_data(self, *, input_path: str) -> "MARWILConfig":
+        """Where the logged episodes live (reference:
+        AlgorithmConfig.offline_data(input_))."""
+        self.input_path = input_path
+        return self
+
+    @property
+    def algo_class(self):
+        return MARWIL
+
+
+class BCConfig(MARWILConfig):
+    """Behavior cloning = MARWIL with beta=0 (reference: bc.py subclasses
+    MARWIL the same way)."""
+
+    def __init__(self):
+        super().__init__()
+        self.training_params["beta"] = 0.0
+
+    @property
+    def algo_class(self):
+        return BC
+
+
+class MARWIL(Algorithm):
+    def setup(self, config: MARWILConfig) -> None:
+        import jax
+        import optax
+
+        from ray_tpu import data as rt_data
+        from ray_tpu.rllib.algorithms.algorithm import build_module_spec
+
+        if config.learner_platform == "cpu":
+            from ray_tpu._private.platform import force_cpu_platform
+
+            force_cpu_platform(1)
+        if not config.input_path:
+            raise ValueError("offline algorithms need "
+                             "config.offline_data(input_path=...)")
+        spec = build_module_spec(config)
+        p = config.training_params
+        self.module = DiscretePolicyModule(
+            observation_size=spec["observation_size"],
+            num_actions=spec["num_actions"], hidden=spec["hidden"])
+        self.params = self.module.init(jax.random.PRNGKey(config.seed))
+        self.tx = optax.chain(optax.clip_by_global_norm(p["grad_clip"]),
+                              optax.adam(p["lr"]))
+        self.opt_state = self.tx.init(self.params)
+        self._norm = jax.numpy.asarray(1.0)
+        self._update = jax.jit(functools.partial(
+            _marwil_update, self.module, self.tx, beta=p["beta"],
+            vf_coef=p["vf_coef"], minibatch=p["minibatch_size"]))
+
+        # the dataset rides ray_tpu.data; dense arrays once, then jit-only
+        rows = rt_data.read_json(config.input_path).take_all()
+        self._obs = np.asarray([r["obs"] for r in rows], np.float32)
+        self._actions = np.asarray([r["action"] for r in rows], np.int64)
+        self._rtg = np.asarray([r["return_to_go"] for r in rows], np.float32)
+        self._rng = np.random.default_rng(config.seed)
+        self._eval_env = None
+
+    def training_step(self) -> Dict[str, Any]:
+        p = self.config.training_params
+        idx = self._rng.integers(0, len(self._obs),
+                                 p["train_batch_size"])
+        batch = {"obs": self._obs[idx], "action": self._actions[idx],
+                 "return_to_go": self._rtg[idx]}
+        self.params, self.opt_state, self._norm, pi_l, vf_l = self._update(
+            self.params, self.opt_state, self._norm, batch)
+        return {"policy_loss": float(pi_l), "vf_loss": float(vf_l),
+                "dataset_size": len(self._obs)}
+
+    def evaluate(self, n_episodes: int = 10) -> Dict[str, float]:
+        """Greedy rollouts of the learned policy (reference:
+        Algorithm.evaluate)."""
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.env import make_vector_env
+
+        if self._eval_env is None:
+            self._eval_env = make_vector_env(self.config.env, 1,
+                                             seed=self.config.seed + 7)
+        env = self._eval_env
+        returns = []
+        for _ in range(n_episodes):
+            obs = env.reset()
+            total = 0.0
+            while True:
+                a = np.asarray(self.module.forward_inference(
+                    self.params, jnp.asarray(obs)))
+                obs, r, term, trunc, _ = env.step(a)
+                total += float(r[0])
+                if bool(term[0] or trunc[0]):
+                    break
+            returns.append(total)
+        return {"episode_return_mean": float(np.mean(returns))}
+
+
+class BC(MARWIL):
+    pass

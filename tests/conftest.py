@@ -20,6 +20,10 @@ sys.path.insert(0, _REPO_ROOT)
 os.environ["PYTHONPATH"] = os.pathsep.join(
     [_REPO_ROOT, _TESTS_DIR, os.environ.get("PYTHONPATH", "")]).rstrip(os.pathsep)
 
+# fault-injection RPCs (nodelet set_env) are production-disabled; tests and
+# every node they spawn get them via this inherited env override
+os.environ["RAY_TPU_TEST_HOOKS"] = "1"
+
 # FORCE cpu: tests must never touch the real chip — the virtual 8-device CPU
 # mesh is the test substrate, and a wedged/contended TPU tunnel must not hang
 # the suite.  (Env var alone is insufficient; see _private/platform.py.)

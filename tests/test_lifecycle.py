@@ -158,3 +158,59 @@ def test_versioned_resource_sync_quiesces(ray_start_cluster):
     b2 = status()["resource_broadcasts"]
     assert b2 > b1, "resource change did not rebroadcast"
     assert ray_tpu.get(ref, timeout=60) == 1
+
+
+def test_disk_full_node_rejects_leases():
+    """FileSystemMonitor semantics (reference: _private/utils
+    FileSystemMonitor): a node over the disk-capacity threshold refuses new
+    leases with a retriable answer, and recovers when space frees."""
+    import time
+
+    import ray_tpu
+    from ray_tpu._private.worker import require_core
+
+    from conftest import ensure_shared_runtime
+
+    ensure_shared_runtime()
+    core = require_core()
+
+    # the fake-usage env hook is read per monitor tick IN the nodelet
+    # process — flip it via the (test_hooks-gated) set_env RPC
+    resp = core.io.run(core.nodelet_conn.call(
+        "set_env", {"key": "RAY_TPU_FAKE_DISK_USAGE", "value": "0.99"}))
+    assert resp
+    granted = []  # leases won before the monitor tick: must be returned
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        r = core.io.run(core.nodelet_conn.call(
+            "request_worker_lease",
+            {"resources": {"CPU": 0.1}, "strategy": {"kind": "hybrid"},
+             "bundle": None, "spillback_count": 0, "token": "t-disk"},
+            timeout=30))
+        if r["type"] == "granted":
+            granted.append(r["lease_id"])
+        if r["type"] == "retry" and "filesystem" in r.get("reason", ""):
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError(f"lease never rejected for disk: {r}")
+    for lease_id in granted:
+        core.io.run(core.nodelet_conn.call("return_worker",
+                                           {"lease_id": lease_id}))
+
+    core.io.run(core.nodelet_conn.call(
+        "set_env", {"key": "RAY_TPU_FAKE_DISK_USAGE", "value": ""}))
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        r = core.io.run(core.nodelet_conn.call(
+            "request_worker_lease",
+            {"resources": {"CPU": 0.1}, "strategy": {"kind": "hybrid"},
+             "bundle": None, "spillback_count": 0, "token": "t-disk2"},
+            timeout=30))
+        if r["type"] == "granted":
+            core.io.run(core.nodelet_conn.call(
+                "return_worker", {"lease_id": r["lease_id"]}))
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError(f"lease never granted after recovery: {r}")
