@@ -15,7 +15,9 @@ client_call.h, server_call.h).  Design differences, deliberately TPU/host-native
 
 Frame layout: [4B header_len][msgpack header][8B inband_len][inband pickle]
               [8B buf_len][buf bytes] * header["nbufs"]
-Header: {"t": 0 req | 1 res | 2 err | 3 notify, "id": int, "m": method}.
+Header: {"t": 0 req | 1 res | 2 err | 3 notify | 4 hello, "id": int,
+"m": method} — hello frames carry version negotiation (see the protocol
+contract block below).
 """
 
 from __future__ import annotations
@@ -36,7 +38,32 @@ from ray_tpu._private.config import RayConfig
 
 logger = logging.getLogger(__name__)
 
-T_REQ, T_RES, T_ERR, T_NOTIFY = 0, 1, 2, 3
+T_REQ, T_RES, T_ERR, T_NOTIFY, T_HELLO = 0, 1, 2, 3, 4
+
+# ------------------------------------------------------- protocol contract
+# Wire format (the IDL-lite; reference analogue: the protobuf service
+# definitions in src/ray/protobuf — here the schema is this documented
+# msgpack frame plus pickled payloads, deliberately codegen-free):
+#
+#   u32 header_len | msgpack header | u64 inband_len | pickled payload
+#   | per-OOB-buffer: u64 len | raw bytes
+#
+# header: {"t": T_*, "id": int, "m": method, "nbufs": int}
+#   T_REQ    request; "m" names an rpc_<m> handler on the peer
+#   T_RES    response (same id); payload is the handler's return value
+#   T_ERR    response (same id); payload is the raised exception
+#   T_NOTIFY fire-and-forget request (id 0, no response)
+#   T_HELLO  version/feature negotiation, sent once by the dialing side
+#            immediately after connect: {"t": T_HELLO, "v": int,
+#            "min": int, "features": [str], "name": str}.  The server
+#            answers with its own T_HELLO.  A peer whose "min" exceeds
+#            PROTOCOL_VERSION is refused (T_ERR + close).  Peers that
+#            never send T_HELLO (older builds) keep working:
+#            peer_version stays None and no feature gating applies.
+PROTOCOL_VERSION = 1
+MIN_COMPATIBLE_VERSION = 1
+PROTOCOL_FEATURES = ("pickle5-oob", "batched-tasks", "chunked-pull",
+                     "task-events", "dag-channels")
 
 _OOB_THRESHOLD = 64 * 1024  # RPC-level threshold for out-of-band buffers
 
@@ -99,6 +126,11 @@ class Connection:
         self._handler_stats: Dict[str, list] = {}
         # Arbitrary metadata slot for the server side (e.g. registered worker id).
         self.context: Dict[str, Any] = {}
+        # Version negotiation state (None until the peer's T_HELLO arrives;
+        # stays None for pre-handshake peers, which remain fully supported).
+        self.peer_version: Optional[int] = None
+        self.peer_features: frozenset = frozenset()
+        self.peer_name: str = ""
 
     @property
     def closed(self) -> bool:
@@ -203,10 +235,24 @@ class Connection:
                     # A bad payload fails only this message, not the connection.
                     self._handle_decode_error(header, t, decode_err)
                     continue
+                if t == T_HELLO:
+                    self._on_hello(header)
+                    continue
                 if t == T_REQ:
                     self._spawn_dispatch(header, obj)
                 elif t == T_NOTIFY:
                     self._spawn_dispatch(header, obj, needs_reply=False)
+                elif t == T_ERR and header.get("m") == "__hello__":
+                    # handshake refusal: no pending future carries id 0 —
+                    # surface the cause loudly before the peer closes on us
+                    logger.error("peer refused connection %s at handshake: "
+                                 "%s", self.name, obj)
+                    for fut in list(self._pending.values()):
+                        if not fut.done():
+                            fut.set_exception(
+                                obj if isinstance(obj, BaseException)
+                                else ConnectionLost(str(obj)))
+                    self._pending.clear()
                 elif t in (T_RES, T_ERR):
                     fut = self._pending.pop(header["id"], None)
                     if fut is not None and not fut.done():
@@ -227,10 +273,62 @@ class Connection:
         finally:
             await self._shutdown()
 
+    def _on_hello(self, header: dict) -> None:
+        """Record the peer's protocol version/features; answer a dialing
+        peer's hello with ours (ack'd, so the exchange terminates)."""
+        self.peer_version = header.get("v")
+        self.peer_features = frozenset(header.get("features") or ())
+        self.peer_name = header.get("name") or ""
+        peer_min = header.get("min", header.get("v", 0))
+        reason = None
+        if peer_min is not None and peer_min > PROTOCOL_VERSION:
+            reason = (f"peer needs protocol >= {peer_min}, this build "
+                      f"speaks {PROTOCOL_VERSION}")
+        elif (self.peer_version or 0) < MIN_COMPATIBLE_VERSION:
+            reason = (f"peer speaks protocol {self.peer_version}, this "
+                      f"build requires >= {MIN_COMPATIBLE_VERSION}")
+        if reason is not None:
+            logger.error("refusing connection %s: %s",
+                         self.peer_name or self.name, reason)
+
+            async def refuse():
+                try:
+                    inband, buffers = _encode(ConnectionLost(
+                        f"incompatible protocol: {reason}"))
+                    await self._send_frame(
+                        {"t": T_ERR, "id": 0, "m": "__hello__",
+                         "nbufs": len(buffers)}, inband, buffers)
+                finally:
+                    await self._shutdown()
+
+            self._spawn_task(refuse())
+            return
+        if not header.get("ack"):
+            async def _ack():
+                try:
+                    await self.send_hello(ack=True)
+                except (ConnectionError, OSError):
+                    pass  # peer vanished between hello and ack
+
+            self._spawn_task(_ack())
+
+    async def send_hello(self, ack: bool = False) -> None:
+        """Raises ConnectionError/OSError if the link is already dead — the
+        dialing side's connect() retry loop relies on that; the server-side
+        ack path wraps it (a reply to a vanished peer is a no-op)."""
+        inband, buffers = _encode(None)
+        await self._send_frame(
+            {"t": T_HELLO, "v": PROTOCOL_VERSION,
+             "min": MIN_COMPATIBLE_VERSION,
+             "features": list(PROTOCOL_FEATURES), "name": self.name,
+             "ack": ack, "id": 0, "m": "__hello__",
+             "nbufs": len(buffers)}, inband, buffers)
+
     def _handle_decode_error(self, header: dict, t: int, decode_err: Exception):
+        names = ("REQ", "RES", "ERR", "NOTIFY", "HELLO")
         err = RaySerializationError(
-            f"failed to decode {('REQ', 'RES', 'ERR', 'NOTIFY')[t]} payload for "
-            f"method {header.get('m')!r}: {decode_err!r}"
+            f"failed to decode {names[t] if t < len(names) else t} payload "
+            f"for method {header.get('m')!r}: {decode_err!r}"
         )
         if t in (T_RES, T_ERR):
             fut = self._pending.pop(header["id"], None)
@@ -388,7 +486,11 @@ async def connect(
     while True:
         try:
             reader, writer = await asyncio.open_connection(host, port)
-            return Connection(reader, writer, handlers or {}, name=name)
+            conn = Connection(reader, writer, handlers or {}, name=name)
+            # fire-and-forget version negotiation: the reply sets
+            # conn.peer_version whenever the server speaks hello
+            await conn.send_hello()
+            return conn
         except (ConnectionError, OSError):
             if time.monotonic() >= deadline:
                 raise

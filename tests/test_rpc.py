@@ -132,3 +132,52 @@ def test_concurrent_calls(io):
 
     assert io.run(many()) == list(range(20))
     io.run(server.stop())
+
+
+def test_protocol_version_negotiation():
+    """T_HELLO handshake: both sides learn the peer's version + features;
+    a peer demanding a newer protocol is refused (reference analogue: the
+    protobuf/service versioning the reference gets from its IDL)."""
+    import asyncio
+    import time
+
+    from ray_tpu._private import rpc
+
+    io = rpc.EventLoopThread(name="t-proto")
+    try:
+        async def setup():
+            server = rpc.Server({}, name="proto-srv")
+            addr = await server.start("127.0.0.1", 0)
+            conn = await rpc.connect(*addr, name="proto-cli")
+            return server, addr, conn
+
+        server, addr, conn = io.run(setup())
+        deadline = time.time() + 10
+        while conn.peer_version is None and time.time() < deadline:
+            time.sleep(0.02)
+        assert conn.peer_version == rpc.PROTOCOL_VERSION
+        assert "pickle5-oob" in conn.peer_features
+        # the server side learned the client too
+        async def server_conns():
+            return list(server.connections)
+        sconns = io.run(server_conns())
+        assert sconns and sconns[0].peer_version == rpc.PROTOCOL_VERSION
+
+        # a peer that REQUIRES a future protocol version is refused
+        async def future_peer():
+            c = await rpc.connect(*addr, name="from-the-future")
+            inband, bufs = rpc._encode(None)
+            await c._send_frame(
+                {"t": rpc.T_HELLO, "v": 99, "min": 99, "features": [],
+                 "name": "future", "id": 0, "m": "__hello__",
+                 "nbufs": len(bufs)}, inband, bufs)
+            for _ in range(100):
+                if c.closed:
+                    return True
+                await asyncio.sleep(0.05)
+            return False
+        assert io.run(future_peer()), "incompatible peer was not dropped"
+        io.run(conn.close())
+        io.run(server.stop())
+    finally:
+        io.stop()
