@@ -185,10 +185,16 @@ def main() -> None:
         import subprocess
         import sys
 
-        code = ("import json, ray_tpu; from ray_tpu._private.ray_perf import "
-                "run_microbenchmarks; "
-                "ray_tpu.init(num_cpus=4, object_store_memory=1024**3); "
-                "print('MICRO=' + json.dumps(run_microbenchmarks()))")
+        # Size the micro cluster like the reference's ray.init() does: to
+        # the CPUs actually available (cgroup/affinity-aware).  Hard-coding
+        # 4 workers oversubscribed the 1-core bench VM with context
+        # switching (3.4k/s vs 8.6k/s async tasks at 1 worker).
+        code = ("import json, ray_tpu; from ray_tpu._private.ray_perf "
+                "import host_cpu_count, run_microbenchmarks; "
+                "n = host_cpu_count(); "
+                "ray_tpu.init(num_cpus=n, object_store_memory=1024**3); "
+                "out = run_microbenchmarks(); out['num_cpus'] = n; "
+                "print('MICRO=' + json.dumps(out))")
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         try:

@@ -31,6 +31,18 @@ BASELINE = {
 }
 
 
+def host_cpu_count() -> int:
+    """CPUs actually available to this process (cgroup/affinity-aware, the
+    way the reference's ray.init() sizes itself — os.cpu_count() would
+    re-oversubscribe inside a CPU-quota'd container)."""
+    import os
+
+    try:
+        return max(len(os.sched_getaffinity(0)), 1)
+    except (AttributeError, OSError):
+        return max(os.cpu_count() or 1, 1)
+
+
 def _rate(fn: Callable[[], int], duration_s: float) -> float:
     """Run fn repeatedly for ~duration_s; fn returns ops done per call."""
     # warmup round
@@ -62,7 +74,10 @@ def run_microbenchmarks(duration_s: float = 2.0,
     def noop():
         return None
 
-    noop_small = noop.options(num_cpus=0.01)
+    # plain 1-CPU tasks, exactly the reference's `small_value` shape
+    # (reference ray_perf.py:59 `@ray.remote` with defaults): fractional
+    # CPUs here let the nodelet lease dozens of workers at once, which on a
+    # small host measures context-switching, not the runtime
 
     @ray_tpu.remote
     class Echo:
@@ -73,7 +88,7 @@ def run_microbenchmarks(duration_s: float = 2.0,
 
     # ------------------------------------------------ tasks, sync
     def tasks_sync():
-        ray_tpu.get(noop_small.remote())
+        ray_tpu.get(noop.remote())
         return 1
 
     results["single_client_tasks_sync"] = _rate(tasks_sync, duration_s)
@@ -82,7 +97,7 @@ def run_microbenchmarks(duration_s: float = 2.0,
     # ------------------------------------------------ tasks, async batches
     def tasks_async():
         n = 1000  # reference ray_perf uses 1000-task async batches
-        ray_tpu.get([noop_small.remote() for _ in range(n)])
+        ray_tpu.get([noop.remote() for _ in range(n)])
         return n
 
     results["single_client_tasks_async"] = _rate(tasks_async, duration_s)
@@ -194,7 +209,9 @@ def main() -> None:
 
     started_here = not ray_tpu.is_initialized()
     if started_here:
-        ray_tpu.init(num_cpus=4, object_store_memory=1024 * 1024**2)
+        # match the reference ray.init(): size workers to the host's cores
+        ray_tpu.init(num_cpus=host_cpu_count(),
+                     object_store_memory=1024 * 1024**2)
     try:
         out = run_microbenchmarks()
     finally:
