@@ -9,8 +9,10 @@ from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.offline import BC, BCConfig, MARWIL, MARWILConfig
 
 __all__ = ["Algorithm", "AlgorithmConfig", "BC", "BCConfig",
            "DQN", "DQNConfig", "IMPALA", "IMPALAConfig",
-           "MARWIL", "MARWILConfig", "PPO", "PPOConfig"]
+           "MARWIL", "MARWILConfig", "PPO", "PPOConfig",
+           "SAC", "SACConfig"]

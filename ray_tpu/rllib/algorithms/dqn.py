@@ -32,11 +32,13 @@ class ReplayBuffer:
     rllib/utils/replay_buffers/replay_buffer.py — storage ring +
     sample(num_items); prioritized variant left to a later round)."""
 
-    def __init__(self, capacity: int, observation_size: int, seed: int = 0):
+    def __init__(self, capacity: int, observation_size: int, seed: int = 0,
+                 action_shape: tuple = (), action_dtype=np.int32):
         self.capacity = int(capacity)
         self.obs = np.empty((capacity, observation_size), np.float32)
         self.next_obs = np.empty((capacity, observation_size), np.float32)
-        self.actions = np.empty((capacity,), np.int32)
+        self.actions = np.empty((capacity,) + tuple(action_shape),
+                                action_dtype)
         self.rewards = np.empty((capacity,), np.float32)
         self.discounts = np.empty((capacity,), np.float32)
         self.dones = np.empty((capacity,), np.float32)

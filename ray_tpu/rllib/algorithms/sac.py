@@ -1,0 +1,309 @@
+"""SAC: soft actor-critic for continuous control.
+
+Counterpart of the reference's SAC (reference: rllib/algorithms/sac/sac.py —
+twin Q, tanh-squashed Gaussian actor, automatic entropy temperature;
+torch loss in sac/torch/sac_torch_learner.py).  TPU-first shape: the whole
+update — critic TD against the entropy-regularized clipped double-Q target,
+actor reparameterized gradient, temperature loss, polyak target sync — is
+ONE jitted ``lax.scan`` over minibatches; a single optimizer steps the
+combined {actor, critic, log_alpha} pytree with stop-gradients partitioning
+the three losses (no per-network Python optimizer loop).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.rl_module import SquashedGaussianModule, TwinQModule
+
+
+# the transition store is DQN's ReplayBuffer with a float action spec
+# (one ring implementation to maintain, not two)
+from ray_tpu.rllib.algorithms.dqn import ReplayBuffer
+
+
+class SACEnvRunner:
+    """Stochastic-policy transition sampler over K vectorized envs (1-step;
+    time-limit truncations bootstrap through ``final_obs``)."""
+
+    def __init__(self, env_name: str, num_envs: int, rollout_length: int,
+                 module_spec: Dict, seed: int = 0):
+        import sys
+
+        if "jax" in sys.modules:
+            import jax._src.xla_bridge as _xb
+
+            initialized = _xb.backends_are_initialized()
+        else:
+            initialized = False
+        if not initialized:
+            from ray_tpu._private.platform import force_cpu_platform
+
+            force_cpu_platform(1)
+        import jax
+
+        from ray_tpu.rllib.env import make_vector_env
+
+        self.env = make_vector_env(env_name, num_envs, seed=seed)
+        self.num_envs = num_envs
+        self.rollout_length = rollout_length
+        self.actor = SquashedGaussianModule(
+            observation_size=module_spec["observation_size"],
+            action_size=module_spec["action_size"],
+            max_action=module_spec["max_action"],
+            hidden=module_spec["hidden"])
+        self._key = jax.random.PRNGKey(seed)
+        self._np_rng = np.random.default_rng(seed + 13)
+        self._sample = jax.jit(self.actor.sample)
+        self.obs = self.env.reset()
+        self._ep_return = np.zeros(num_envs, np.float32)
+        self._recent_returns: list = []
+
+    def sample(self, params, random_actions: bool = False
+               ) -> Dict[str, np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+
+        out = {k: [] for k in ("obs", "actions", "rewards", "next_obs",
+                               "dones")}
+        for _ in range(self.rollout_length):
+            if random_actions:
+                a = self._np_rng.uniform(
+                    -self.actor.max_action, self.actor.max_action,
+                    (self.num_envs, self.actor.action_size)).astype(np.float32)
+            else:
+                self._key, sub = jax.random.split(self._key)
+                a, _ = self._sample(params, jnp.asarray(self.obs), sub)
+                a = np.asarray(a)
+            nxt, r, term, trunc, info = self.env.step(a[:, 0]
+                                                      if a.shape[1] == 1
+                                                      else a)
+            done = term | trunc
+            # bootstrap target uses the PRE-reset obs at done slots
+            succ = np.where(done[:, None], info["final_obs"], nxt)
+            out["obs"].append(self.obs.copy())
+            out["actions"].append(a)
+            out["rewards"].append(r)
+            out["next_obs"].append(succ)
+            out["dones"].append(term.astype(np.float32))  # not truncations
+            self._ep_return += r
+            for i in np.nonzero(done)[0]:
+                self._recent_returns.append(float(self._ep_return[i]))
+                self._ep_return[i] = 0.0
+            self.obs = nxt
+        self._recent_returns = self._recent_returns[-100:]
+        return {k: np.concatenate(v) for k, v in out.items()}
+
+    def get_metrics(self) -> Dict[str, Any]:
+        r = self._recent_returns
+        return {"episode_return_mean": float(np.mean(r)) if r else None,
+                "episodes": len(r)}
+
+    def ping(self) -> bool:
+        return True
+
+
+def _sac_update(actor_mod, critic_mod, tx, params, target_q, opt_state,
+                key, batches, *, tau: float, target_entropy: float):
+    import jax
+    import jax.numpy as jnp
+
+    def loss_fn(p, target_q, mb, key):
+        alpha = jnp.exp(p["log_alpha"])
+        k1, k2 = jax.random.split(key)
+
+        # ------- critic: TD against entropy-regularized double-Q target
+        a_next, logp_next = actor_mod.sample(
+            jax.lax.stop_gradient(p["actor"]), mb["next_obs"], k1)
+        q1_t, q2_t = critic_mod.q_values(target_q, mb["next_obs"], a_next)
+        y = mb["rewards"] + mb["discounts"] * (1.0 - mb["dones"]) * (
+            jnp.minimum(q1_t, q2_t)
+            - jax.lax.stop_gradient(alpha) * logp_next)
+        y = jax.lax.stop_gradient(y)
+        q1, q2 = critic_mod.q_values(p["critic"], mb["obs"], mb["actions"])
+        critic_loss = ((q1 - y) ** 2 + (q2 - y) ** 2).mean()
+
+        # ------- actor: reparameterized, against frozen critics
+        a_pi, logp_pi = actor_mod.sample(p["actor"], mb["obs"], k2)
+        q1_pi, q2_pi = critic_mod.q_values(
+            jax.lax.stop_gradient(p["critic"]), mb["obs"], a_pi)
+        actor_loss = (jax.lax.stop_gradient(alpha) * logp_pi
+                      - jnp.minimum(q1_pi, q2_pi)).mean()
+
+        # ------- temperature (automatic entropy tuning)
+        alpha_loss = (-jnp.exp(p["log_alpha"])
+                      * jax.lax.stop_gradient(logp_pi + target_entropy)
+                      ).mean()
+        total = critic_loss + actor_loss + alpha_loss
+        return total, (critic_loss, actor_loss, alpha)
+
+    def body(carry, inp):
+        params, target_q, opt_state = carry
+        mb, k = inp
+        (_, (c_l, a_l, alpha)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, target_q, mb, k)
+        import optax
+
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        target_q = jax.tree_util.tree_map(
+            lambda t, s: (1.0 - tau) * t + tau * s, target_q,
+            params["critic"])
+        return (params, target_q, opt_state), (c_l, a_l, alpha)
+
+    n_mb = batches["obs"].shape[0]
+    keys = jax.random.split(key, n_mb)
+    (params, target_q, opt_state), (c_ls, a_ls, alphas) = jax.lax.scan(
+        body, (params, target_q, opt_state), (batches, keys))
+    return params, target_q, opt_state, jnp.mean(c_ls), jnp.mean(a_ls), \
+        alphas[-1]
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_envs_per_env_runner = 8
+        self.rollout_fragment_length = 64
+        self.training_params = {
+            "lr": 3e-4,
+            "gamma": 0.99,
+            "tau": 0.005,
+            "buffer_size": 200_000,
+            "batch_size": 256,
+            "num_updates_per_iter": 512,  # 1 grad step per env step (SAC standard)
+            "learning_starts": 1_500,
+            "random_warmup": True,
+        }
+
+    @property
+    def algo_class(self):
+        return SAC
+
+
+class SAC(Algorithm):
+    def setup(self, config: SACConfig) -> None:
+        import jax
+        import optax
+
+        from ray_tpu.rllib.env import make_vector_env
+
+        if config.learner_platform == "cpu":
+            from ray_tpu._private.platform import force_cpu_platform
+
+            force_cpu_platform(1)
+        probe = make_vector_env(config.env, 1, seed=0)
+        if not getattr(probe, "continuous", False):
+            raise ValueError(f"SAC needs a continuous-action env; "
+                             f"{config.env} is discrete")
+        p = config.training_params
+        spec = {"observation_size": probe.observation_size,
+                "action_size": probe.action_size,
+                "max_action": probe.max_action,
+                "hidden": tuple(config.model.get("hidden", (64, 64)))}
+        self.actor_mod = SquashedGaussianModule(
+            observation_size=spec["observation_size"],
+            action_size=spec["action_size"],
+            max_action=spec["max_action"], hidden=spec["hidden"])
+        self.critic_mod = TwinQModule(
+            observation_size=spec["observation_size"],
+            action_size=spec["action_size"], hidden=spec["hidden"])
+        ka, kc = jax.random.split(jax.random.PRNGKey(config.seed))
+        self.params = {
+            "actor": self.actor_mod.init(ka),
+            "critic": self.critic_mod.init(kc),
+            "log_alpha": jax.numpy.asarray(0.0),
+        }
+        self.target_q = self.params["critic"]
+        self.tx = optax.adam(p["lr"])
+        self.opt_state = self.tx.init(self.params)
+        self._key = jax.random.PRNGKey(config.seed + 1)
+        self._update = jax.jit(functools.partial(
+            _sac_update, self.actor_mod, self.critic_mod, self.tx,
+            tau=p["tau"], target_entropy=-float(spec["action_size"])))
+
+        self.buffer = ReplayBuffer(
+            p["buffer_size"], spec["observation_size"], seed=config.seed,
+            action_shape=(spec["action_size"],), action_dtype=np.float32)
+        self._steps_sampled = 0
+
+        runner_kwargs = dict(
+            env_name=config.env, num_envs=config.num_envs_per_env_runner,
+            rollout_length=config.rollout_fragment_length,
+            module_spec=spec, seed=config.seed)
+        self._runner_actors = []
+        self._local_runner = None
+        if config.num_env_runners <= 0:
+            self._local_runner = SACEnvRunner(**runner_kwargs)
+        else:
+            from ray_tpu.rllib.algorithms.algorithm import build_runner_actors
+
+            self._runner_actors = build_runner_actors(
+                config, SACEnvRunner, runner_kwargs)
+
+    def training_step(self) -> Dict[str, Any]:
+        import jax
+
+        import ray_tpu
+
+        p = self.config.training_params
+        warmup = p["random_warmup"] and \
+            self._steps_sampled < p["learning_starts"]
+        if self._local_runner is not None:
+            batches = [self._local_runner.sample(self.params["actor"],
+                                                 random_actions=warmup)]
+            metrics = [self._local_runner.get_metrics()]
+        else:
+            wref = ray_tpu.put(self.params["actor"])
+            batches = ray_tpu.get([r.sample.remote(wref, warmup)
+                                   for r in self._runner_actors])
+            metrics = ray_tpu.get([r.get_metrics.remote()
+                                   for r in self._runner_actors])
+        for b in batches:
+            # 1-step transitions: constant per-step discount
+            disc = np.full(len(b["rewards"]), p["gamma"], np.float32)
+            self.buffer.add_batch(b["obs"], b["actions"], b["rewards"],
+                                  b["next_obs"], disc, b["dones"])
+            self._steps_sampled += len(b["rewards"])
+
+        stats: Dict[str, Any] = {}
+        if self._steps_sampled >= p["learning_starts"]:
+            idx = self.buffer.sample_indices(p["num_updates_per_iter"],
+                                             p["batch_size"])
+            mbs = self.buffer.gather(idx)
+            self._key, sub = jax.random.split(self._key)
+            (self.params, self.target_q, self.opt_state, c_l, a_l,
+             alpha) = self._update(self.params, self.target_q,
+                                   self.opt_state, sub, mbs)
+            stats = {"critic_loss": float(c_l), "actor_loss": float(a_l),
+                     "alpha": float(alpha)}
+        rets = [m["episode_return_mean"] for m in metrics
+                if m["episode_return_mean"] is not None]
+        return {"episode_return_mean":
+                float(np.mean(rets)) if rets else None,
+                "steps_sampled": self._steps_sampled, **stats}
+
+    def evaluate(self, n_episodes: int = 5) -> Dict[str, float]:
+        import jax.numpy as jnp
+
+        from ray_tpu.rllib.env import make_vector_env
+
+        env = make_vector_env(self.config.env, 1,
+                              seed=self.config.seed + 99)
+        returns = []
+        for _ in range(n_episodes):
+            obs = env.reset()
+            total = 0.0
+            while True:
+                a = np.asarray(self.actor_mod.forward_inference(
+                    self.params["actor"], jnp.asarray(obs)))
+                obs, r, term, trunc, _ = env.step(
+                    a[:, 0] if a.shape[1] == 1 else a)
+                total += float(r[0])
+                if bool(term[0] or trunc[0]):
+                    break
+            returns.append(total)
+        return {"episode_return_mean": float(np.mean(returns))}

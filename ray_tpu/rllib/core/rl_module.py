@@ -40,6 +40,14 @@ def _apply_mlp(params, x):
     return x
 
 
+def _apply_relu_mlp(layers, x, final_relu: bool = False):
+    for i, layer in enumerate(layers):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(layers) - 1 or final_relu:
+            x = jax.nn.relu(x)
+    return x
+
+
 class DiscretePolicyModule:
     """Separate policy/value tanh MLPs for discrete action spaces
     (reference default: vf_share_layers=False)."""
@@ -112,13 +120,7 @@ class QModule:
                 "adv": _init_mlp(ka, (last, self.num_actions)),
                 "val": _init_mlp(kv, (last, 1))}
 
-    @staticmethod
-    def _relu_mlp(layers, x, final_relu: bool):
-        for i, layer in enumerate(layers):
-            x = x @ layer["w"] + layer["b"]
-            if i < len(layers) - 1 or final_relu:
-                x = jax.nn.relu(x)
-        return x
+    _relu_mlp = staticmethod(_apply_relu_mlp)
 
     def q_values(self, params, obs) -> jnp.ndarray:
         if not self.dueling:
@@ -130,3 +132,71 @@ class QModule:
 
     def forward_inference(self, params, obs) -> jnp.ndarray:
         return jnp.argmax(self.q_values(params, obs), axis=-1)
+
+
+class SquashedGaussianModule:
+    """Continuous-control actor: tanh-squashed Gaussian policy (the SAC
+    actor; reference: rllib's SACTorchRLModule action dist
+    TorchSquashedGaussian).  ``sample`` returns (action, logp) with the
+    tanh change-of-variables correction; actions scale to [-max_action,
+    max_action]."""
+
+    LOG_STD_MIN = -10.0
+    LOG_STD_MAX = 2.0
+
+    def __init__(self, observation_size: int, action_size: int,
+                 max_action: float = 1.0, hidden: Sequence[int] = (64, 64)):
+        self.observation_size = observation_size
+        self.action_size = action_size
+        self.max_action = float(max_action)
+        self.hidden = tuple(hidden)
+
+    def init(self, key) -> Dict:
+        sizes = (self.observation_size, *self.hidden, 2 * self.action_size)
+        return {"pi": _init_mlp(key, sizes, final_scale=0.01)}
+
+    def _dist(self, params, obs):
+        out = _apply_mlp(params["pi"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, self.LOG_STD_MIN, self.LOG_STD_MAX)
+        return mean, log_std
+
+    def sample(self, params, obs, key) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        mean, log_std = self._dist(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape)
+        pre = mean + std * eps
+        act = jnp.tanh(pre)
+        # log N(pre) - log |d tanh/d pre| (numerically stable softplus form)
+        logp = (-0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+                ).sum(axis=-1)
+        logp -= (2.0 * (jnp.log(2.0) - pre
+                        - jax.nn.softplus(-2.0 * pre))).sum(axis=-1)
+        # scaling by max_action is part of the bijector: its Jacobian
+        # contributes -sum(log max_action) to the density of the action
+        logp -= self.action_size * jnp.log(self.max_action)
+        return act * self.max_action, logp
+
+    def forward_inference(self, params, obs) -> jnp.ndarray:
+        mean, _ = self._dist(params, obs)
+        return jnp.tanh(mean) * self.max_action
+
+
+class TwinQModule:
+    """Twin continuous Q(s, a) critics (clipped double-Q; reference: SAC's
+    twin_q=True default)."""
+
+    def __init__(self, observation_size: int, action_size: int,
+                 hidden: Sequence[int] = (64, 64)):
+        sizes = (observation_size + action_size, *hidden, 1)
+        self._sizes = sizes
+
+    def init(self, key) -> Dict:
+        k1, k2 = jax.random.split(key)
+        return {"q1": _init_mlp(k1, self._sizes),
+                "q2": _init_mlp(k2, self._sizes)}
+
+    def q_values(self, params, obs, act) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        x = jnp.concatenate([obs, act], axis=-1)
+        return (_apply_relu_mlp(params["q1"], x)[..., 0],
+                _apply_relu_mlp(params["q2"], x)[..., 0])

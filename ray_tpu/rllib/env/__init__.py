@@ -24,9 +24,12 @@ def make_vector_env(name: str, num_envs: int, seed: int = 0):
 
 def _register_builtins():
     from ray_tpu.rllib.env.cartpole import CartPoleVectorEnv
+    from ray_tpu.rllib.env.pendulum import PendulumVectorEnv
 
     register_env("CartPole-v1",
                  lambda num_envs, seed=0: CartPoleVectorEnv(num_envs, seed=seed))
+    register_env("Pendulum-v1",
+                 lambda num_envs, seed=0: PendulumVectorEnv(num_envs, seed=seed))
 
 
 _register_builtins()

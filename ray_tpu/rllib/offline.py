@@ -104,9 +104,10 @@ def _marwil_update(module, tx, params, opt_state, norm, batch, *,
         params, opt_state, norm = carry
         (_, (norm, pi_l, vf_l)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params, norm, mb)
+        import optax
+
         updates, opt_state = tx.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(
-            lambda a, b: a + b, params, updates)
+        params = optax.apply_updates(params, updates)
         return (params, opt_state, norm), (pi_l, vf_l)
 
     (params, opt_state, norm), (pi_ls, vf_ls) = jax.lax.scan(

@@ -343,3 +343,43 @@ def test_offline_bc_and_marwil_learn_from_dataset(ray_start_regular, tmp_path):
     mev = malgo.evaluate(n_episodes=5)
     assert mev["episode_return_mean"] >= 0.6 * behavior_return, (
         mev, behavior_return)
+
+
+def test_pendulum_env_semantics():
+    """Native Pendulum matches Gymnasium-v1 constants: reward bounds,
+    truncation at 200, velocity clamp."""
+    import numpy as np
+
+    from ray_tpu.rllib.env import make_vector_env
+
+    env = make_vector_env("Pendulum-v1", 4, seed=0)
+    obs = env.reset()
+    assert obs.shape == (4, 3)
+    # cos^2 + sin^2 = 1
+    np.testing.assert_allclose(obs[:, 0] ** 2 + obs[:, 1] ** 2, 1.0,
+                               atol=1e-5)
+    for t in range(200):
+        obs, r, term, trunc, info = env.step(np.zeros(4, np.float32))
+        assert (r <= 0).all() and (r >= -17).all()
+        assert not term.any()
+    assert trunc.all(), "no truncation at 200 steps"
+    assert np.abs(obs[:, 2]).max() <= env.MAX_SPEED + 1e-5
+
+
+def test_sac_pendulum_learns(ray_start_regular):
+    """SAC (reference: rllib/algorithms/sac) learns Pendulum swing-up:
+    greedy eval return well above the random-policy floor (~-1200);
+    observed ~-120 at 45 iters with the 1:1 update ratio."""
+    from ray_tpu.rllib import SACConfig
+
+    cfg = (SACConfig().environment("Pendulum-v1")
+           .learners(platform="cpu").debugging(seed=0))
+    algo = cfg.build()
+    for _ in range(45):
+        out = algo.train()
+    assert out["steps_sampled"] >= 20_000
+    ev = algo.evaluate(n_episodes=5)
+    assert ev["episode_return_mean"] >= -400.0, (ev, out)
+    # the temperature auto-tuned DOWN from its 1.0 init as the policy
+    # sharpened
+    assert out["alpha"] < 0.9
