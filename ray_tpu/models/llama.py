@@ -1,0 +1,163 @@
+"""Llama-family decoder in flax, TPU-first: RoPE + RMSNorm + SwiGLU + GQA.
+
+Second model family beside GPT-2 (models/gpt2.py), covering the modern
+pretraining recipe: rotary position embeddings (no learned positions),
+pre-RMSNorm blocks, SwiGLU MLPs, grouped-query attention (n_kv_heads <
+n_heads), untied LM head.  Same TPU discipline as the GPT stack —
+bfloat16 activations, fused QKV-free layout matched to
+``llama_partition_rules`` so tp/fsdp shardings apply by regex, attention
+via the Pallas flash kernel (``ray_tpu.ops.flash_attention``) or ring
+attention under an ``sp`` axis — and the same ``ShardedPretrainer`` drives
+it (reference analogue: the reference trains models through external
+libs; the in-repo flagship models are this framework's own).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import (flash_attention, mha_reference,
+                                   ring_attention_sharded)
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    n_positions: int = 2048          # max seq (RoPE extrapolates beyond)
+    d_model: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    n_kv_head: int = 4               # GQA: kv heads shared across q groups
+    d_ff: int = 2048                 # SwiGLU hidden
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "flash"    # "flash" | "ring" | "reference"
+    ring_axis: str = "sp"
+    remat: bool = True
+    remat_policy: str = "full"
+    moe_every: int = 0               # pretrainer compatibility (dense only)
+
+    @staticmethod
+    def tiny() -> "LlamaConfig":
+        return LlamaConfig(vocab_size=512, n_positions=128, d_model=64,
+                           n_layer=2, n_head=4, n_kv_head=2, d_ff=128)
+
+
+def rope_frequencies(head_dim: int, positions, theta: float):
+    """(S, head_dim/2) cos/sin tables for the given absolute positions."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                      dtype=jnp.float32) / head_dim))
+    ang = positions.astype(jnp.float32)[:, None] * inv[None, :]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin):
+    """x: (B, H, S, D); rotate-half (GPT-NeoX) convention — pairs
+    (x_i, x_{i+D/2}) rotate by the position angle.  NOT the interleaved
+    Meta-original layout: checkpoints using that convention need their
+    wq/wk columns permuted before loading."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    # cos/sin: (S, D/2) -> broadcast over (B, H)
+    rot1 = x1 * cos - x2 * sin
+    rot2 = x2 * cos + x1 * sin
+    return jnp.concatenate([rot1, rot2], axis=-1).astype(x.dtype)
+
+
+class LlamaAttention(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        B, S, E = x.shape
+        H, KV = cfg.n_head, cfg.n_kv_head
+        D = E // H
+        assert H % KV == 0, "n_head must be a multiple of n_kv_head"
+        q = nn.Dense(H * D, use_bias=False, dtype=cfg.dtype, name="wq")(x)
+        k = nn.Dense(KV * D, use_bias=False, dtype=cfg.dtype, name="wk")(x)
+        v = nn.Dense(KV * D, use_bias=False, dtype=cfg.dtype, name="wv")(x)
+        q = q.reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        k = k.reshape(B, S, KV, D).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, KV, D).transpose(0, 2, 1, 3)
+        cos, sin = rope_frequencies(D, positions, cfg.rope_theta)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if KV != H:  # GQA: each kv head serves H/KV query heads
+            rep = H // KV
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        if cfg.attention_impl == "ring":
+            out = ring_attention_sharded(q, k, v, causal=True,
+                                         seq_axis=cfg.ring_axis)
+        elif cfg.attention_impl == "reference":
+            out = mha_reference(q, k, v, causal=True)
+        else:
+            out = flash_attention(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(B, S, H * D)
+        return nn.Dense(E, use_bias=False, dtype=cfg.dtype, name="wo")(out)
+
+
+class SwiGLU(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        gate = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                        name="gate_proj")(x)
+        up = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                      name="up_proj")(x)
+        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                        name="down_proj")(jax.nn.silu(gate) * up)
+
+
+class LlamaBlock(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.config
+        x = x + LlamaAttention(cfg, name="attn")(
+            nn.RMSNorm(epsilon=cfg.rms_eps, dtype=cfg.dtype,
+                       name="attn_norm")(x), positions)
+        x = x + SwiGLU(cfg, name="mlp")(
+            nn.RMSNorm(epsilon=cfg.rms_eps, dtype=cfg.dtype,
+                       name="mlp_norm")(x))
+        return x
+
+
+class LlamaLMModel(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic: bool = True):
+        cfg = self.config
+        B, S = input_ids.shape
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                     name="wte")(input_ids)
+        positions = jnp.arange(S)
+        if cfg.remat:
+            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                      if cfg.remat_policy == "dots" else None)
+            block_cls = nn.remat(LlamaBlock, policy=policy)
+        else:
+            block_cls = LlamaBlock
+        for i in range(cfg.n_layer):
+            x = block_cls(cfg, name=f"h_{i}")(x, positions)
+        x = nn.RMSNorm(epsilon=cfg.rms_eps, dtype=cfg.dtype, name="norm_f")(x)
+        return nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                        name="lm_head")(x)
+
+
+def llama_partition_rules():
+    """Megatron-style tp x fsdp rules for the Llama layout (lives beside
+    gpt_partition_rules in parallel/sharding.py)."""
+    from ray_tpu.parallel.sharding import llama_partition_rules as _rules
+
+    return _rules()

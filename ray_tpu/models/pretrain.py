@@ -35,13 +35,23 @@ def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
     )
 
 
-def init_params(config: GPT2Config, rng=None):
-    model = GPT2LMModel(config)
+def _model_family(config):
+    """(model_class, partition_rules_fn) by config type — GPT-2 and the
+    Llama family share the whole sharded-pretrain stack."""
+    from ray_tpu.models import llama
+
+    if isinstance(config, llama.LlamaConfig):
+        return llama.LlamaLMModel, llama.llama_partition_rules
+    return GPT2LMModel, gpt_partition_rules
+
+
+def init_params(config, rng=None):
+    cls, _ = _model_family(config)
+    model = cls(config)
     # Param shapes are independent of the attention impl; init with the
     # reference impl so initialization never needs an active mesh (ring
     # attention requires one) nor block-aligned dummy shapes (flash).
-    init_model = GPT2LMModel(
-        dataclasses.replace(config, attention_impl="reference"))
+    init_model = cls(dataclasses.replace(config, attention_impl="reference"))
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     dummy = jnp.zeros((1, min(8, config.n_positions)), jnp.int32)
     return model, init_model.init(rng, dummy)["params"]
@@ -75,7 +85,7 @@ def train_step(model, tx, state, batch):
 class ShardedPretrainer:
     """Owns mesh + sharded state + compiled step for one jax (multi-)process."""
 
-    def __init__(self, config: GPT2Config, mesh_config: Optional[MeshConfig] = None,
+    def __init__(self, config, mesh_config: Optional[MeshConfig] = None,
                  lr: float = 3e-4, devices=None, total_steps: int = 10_000):
         self.config = config
         self.mesh = build_mesh(mesh_config or MeshConfig(), devices=devices)
@@ -85,7 +95,7 @@ class ShardedPretrainer:
             self.config = config
         self.model, params = init_params(config)
         self.tx = make_optimizer(lr, total_steps=total_steps)
-        rules = gpt_partition_rules()
+        rules = _model_family(config)[1]()
         self.param_specs = match_partition_rules(rules, params)
         opt_state = self.tx.init(params)
         self.opt_specs = match_partition_rules(rules, opt_state)

@@ -64,6 +64,21 @@ def gpt_partition_rules() -> PartitionRules:
     ])
 
 
+def llama_partition_rules() -> PartitionRules:
+    """Megatron-style TP + FSDP sharding for the Llama family
+    (ray_tpu.models.llama): same recipe as gpt_partition_rules, names
+    matched to the RoPE/RMSNorm/SwiGLU module layout."""
+    return PartitionRules([
+        (r"wte/embedding", _spec("tp", "fsdp")),
+        (r"attn/(wq|wk|wv)/kernel", _spec("fsdp", "tp")),
+        (r"attn/wo/kernel", _spec("tp", "fsdp")),
+        (r"mlp/(gate_proj|up_proj)/kernel", _spec("fsdp", "tp")),
+        (r"mlp/down_proj/kernel", _spec("tp", "fsdp")),
+        (r"norm|scale", _spec()),
+        (r"lm_head/kernel", _spec("fsdp", "tp")),
+    ])
+
+
 def _flatten_with_paths(tree):
     import jax
 
