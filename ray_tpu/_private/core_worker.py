@@ -2109,7 +2109,9 @@ class NormalTaskSubmitter:
 
             ekey = renv_mod.env_key(spec.runtime_env)
             msg = {"resources": spec.resources,
-                   "strategy": {"kind": s.kind, "node_id": s.node_id, "soft": s.soft},
+                   "strategy": {"kind": s.kind, "node_id": s.node_id,
+                                "soft": s.soft,
+                                "label_selector": s.label_selector},
                    "bundle": bundle, "spillback_count": 0, "token": token,
                    "env_key": ekey,
                    "runtime_env": spec.runtime_env if ekey else None}

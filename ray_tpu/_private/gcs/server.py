@@ -715,19 +715,30 @@ class GcsServer:
         return True
 
     # ---------------------------------------------------------------- actors
-    def _pick_node_for(self, resources: Dict[str, float]) -> Optional[NodeInfo]:
+    def _pick_node_for(self, resources: Dict[str, float],
+                       label_selector: Optional[dict] = None
+                       ) -> Optional[NodeInfo]:
         """GCS-side actor placement (reference: GcsActorScheduler::ScheduleByGcs,
-        gcs_actor_scheduler.cc:60) — least-loaded feasible node."""
+        gcs_actor_scheduler.cc:60) — least-loaded feasible node; hard label
+        selectors filter, soft selectors outrank headroom."""
+        hard = (label_selector or {}).get("hard") or {}
+        soft = (label_selector or {}).get("soft") or {}
         best, best_score = None, None
         for info in self.nodes.values():
             if not info.alive:
+                continue
+            if hard and any(info.labels.get(k) != v for k, v in hard.items()):
                 continue
             if any(info.resources_total.get(k, 0.0) < v for k, v in resources.items() if v > 0):
                 continue
             if any(info.resources_available.get(k, 0.0) < v for k, v in resources.items() if v > 0):
                 continue
-            # LeastResourceScorer-style: prefer the node with most headroom.
+            # LeastResourceScorer-style: prefer the node with most headroom;
+            # soft label matches dominate the headroom term
             score = sum(info.resources_available.get(k, 0.0) for k in ("CPU",))
+            if soft:
+                score += 1e9 * sum(info.labels.get(k) == v
+                                   for k, v in soft.items())
             if best_score is None or score > best_score:
                 best, best_score = info, score
         return best
@@ -786,7 +797,9 @@ class GcsServer:
                     await self._publish_actor(info)
                     return
             if target is None:
-                target = self._pick_node_for(spec.resources)
+                target = self._pick_node_for(
+                    spec.resources,
+                    s.label_selector if s.kind == "node_label" else None)
             if target is not None:
                 try:
                     # No timeout: this RPC spans the actor's __init__ (can be

@@ -95,6 +95,7 @@ class Node:
         head: bool = False,
         gcs_addr: Optional[Tuple[str, int]] = None,
         resources: Optional[Dict[str, float]] = None,
+        labels: Optional[Dict[str, str]] = None,
         object_store_memory: Optional[int] = None,
         session_dir: Optional[str] = None,
         node_name: str = "",
@@ -104,6 +105,7 @@ class Node:
         self.nodelet_addr: Optional[Tuple[str, int]] = None
         self.node_id_hex: Optional[str] = None
         self.resources = resources
+        self.labels = labels
         self.object_store_memory = object_store_memory
         self.session_dir = session_dir or _session_dir()
         self.node_name = node_name
@@ -131,6 +133,7 @@ class Node:
             "--gcs-host", self.gcs_addr[0], "--gcs-port", str(self.gcs_addr[1]),
             "--session-dir", self.session_dir,
             "--resources", json.dumps(self.resources or {}),
+            "--labels", json.dumps(self.labels or {}),
             "--node-name", self.node_name,
         ]
         if self.object_store_memory:

@@ -1026,6 +1026,29 @@ class Nodelet:
         if not feasible:
             return None
         kind = strategy.get("kind", "default")
+        if kind == "node_label":
+            # label policy (reference: NodeLabelSchedulingStrategy,
+            # node_label_scheduling_policy.h): hard selectors filter,
+            # soft selectors rank; resources break ties via readiness
+            sel = strategy.get("label_selector") or {}
+            hard = sel.get("hard") or {}
+            soft = sel.get("soft") or {}
+
+            def labels_of(f):
+                nid, view, _ = f
+                return self.labels if nid == my_id \
+                    else (view.get("labels") or {})
+
+            if hard:
+                feasible = [f for f in feasible if all(
+                    labels_of(f).get(k) == v for k, v in hard.items())]
+                if not feasible:
+                    return None  # no labeled node: stays pending demand
+            pool = [f for f in feasible if f[2]] or feasible
+            if soft:
+                pool.sort(key=lambda f: -sum(
+                    labels_of(f).get(k) == v for k, v in soft.items()))
+            return pool[0][0]
         ready = [f for f in feasible if f[2]]
         # Score by the REQUESTED resource shape, not CPU alone: a TPU-saturated
         # node must not look idle to a TPU task just because its CPUs are free
@@ -1100,6 +1123,22 @@ class Nodelet:
             max_spill = RayConfig.max_lease_spillbacks
             target = self._pick_node(resources, strategy) if consult else None
             if consult and target is None:
+                if strategy.get("kind") == "node_label":
+                    # resources may fit HERE, but a hard label selector that
+                    # matched no node must never fall through to a local
+                    # grant on a non-matching node.  NOT recorded as
+                    # resource demand: the autoscaler would provision
+                    # generic capacity that still lacks the label.
+                    sel = strategy.get("label_selector") or {}
+                    now = time.monotonic()
+                    if now - getattr(self, "_label_warned", 0.0) > 30.0:
+                        self._label_warned = now
+                        logger.warning(
+                            "task requiring labels %s matches no node; it "
+                            "stays pending (label-selector demand is not "
+                            "autoscalable)", sel.get("hard"))
+                    return {"type": "retry", "delay": 1.0,
+                            "reason": "no node matches the label selector"}
                 if not self._feasible_local(resources):
                     # No node fits today — but the autoscaler may launch one:
                     # record the unmet shape as demand and have the submitter
@@ -1342,6 +1381,8 @@ def main(argv=None):
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}",
+                        help="JSON node labels for label-selector scheduling")
     parser.add_argument("--object-store-memory", type=int, default=0)
     parser.add_argument("--session-dir", default="/tmp/ray_tpu")
     parser.add_argument("--node-name", default="")
@@ -1357,6 +1398,7 @@ def main(argv=None):
         nodelet = Nodelet(
             (args.gcs_host, args.gcs_port),
             resources=json.loads(args.resources) or None,
+            labels=json.loads(args.labels) or None,
             object_store_memory=args.object_store_memory or None,
             session_dir=args.session_dir,
             node_name=args.node_name,

@@ -12,6 +12,7 @@ from typing import Any, Dict, Optional, Tuple
 from ray_tpu._private.task_spec import SchedulingStrategy
 from ray_tpu.util.scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
     PlacementGroupSchedulingStrategy,
 )
 
@@ -101,4 +102,8 @@ def strategy_from_options(opts: Dict[str, Any]) -> SchedulingStrategy:
         )
     if isinstance(s, NodeAffinitySchedulingStrategy):
         return SchedulingStrategy(kind="node_affinity", node_id=s.node_id, soft=s.soft)
+    if isinstance(s, NodeLabelSchedulingStrategy):
+        return SchedulingStrategy(kind="node_label",
+                                  label_selector={"hard": dict(s.hard),
+                                                  "soft": dict(s.soft)})
     raise ValueError(f"invalid scheduling_strategy: {s!r}")

@@ -33,6 +33,7 @@ class Cluster:
 
     def add_node(self, *, num_cpus: Optional[float] = None,
                  resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
                  object_store_memory: Optional[int] = None,
                  node_name: str = "") -> Node:
         res = dict(resources or {})
@@ -43,6 +44,7 @@ class Cluster:
             head=self.head_node is None,
             gcs_addr=self.head_node.gcs_addr if self.head_node else None,
             resources=res or None,
+            labels=labels,
             object_store_memory=object_store_memory,
             session_dir=self.head_node.session_dir if self.head_node else None,
             node_name=node_name or f"node{self._n}",

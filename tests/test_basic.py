@@ -307,3 +307,52 @@ def test_spill_chain_end_bounces_off_small_node():
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
+
+
+def test_node_label_scheduling_strategy():
+    """NodeLabelSchedulingStrategy (reference: util/scheduling_strategies +
+    node_label_scheduling_policy): hard selectors pin tasks to matching
+    nodes; soft selectors prefer them; an unmatched hard selector keeps the
+    task pending rather than landing on a wrong node."""
+    import time
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import NodeLabelSchedulingStrategy
+
+    ray_tpu.shutdown()
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=2, labels={"zone": "us-a", "tier": "cpu"})
+        ray_tpu.init(address=cluster.address)
+        cluster.add_node(num_cpus=2, labels={"zone": "us-b", "tier": "tpu"})
+        cluster.wait_for_nodes()
+
+        @ray_tpu.remote
+        def where():
+            return ray_tpu.get_runtime_context().node_id.hex()
+
+        n1 = cluster.head_node.node_id_hex
+        n2 = cluster.worker_nodes[0].node_id_hex
+
+        # hard selector routes to the tpu-tier node (node2)
+        hard = NodeLabelSchedulingStrategy(hard={"tier": "tpu"})
+        outs = ray_tpu.get(
+            [where.options(scheduling_strategy=hard).remote()
+             for _ in range(4)], timeout=120)
+        assert all(o == n2 for o in outs), (outs, n2)
+
+        # soft selector prefers us-a but still runs
+        soft = NodeLabelSchedulingStrategy(soft={"zone": "us-a"})
+        outs = ray_tpu.get(
+            [where.options(scheduling_strategy=soft).remote()
+             for _ in range(4)], timeout=120)
+        assert n1 in outs, (outs, n1)
+
+        # unmatched hard selector: stays pending, never lands anywhere
+        none = NodeLabelSchedulingStrategy(hard={"tier": "gpu"})
+        ref = where.options(scheduling_strategy=none).remote()
+        ready, not_ready = ray_tpu.wait([ref], timeout=4)
+        assert not ready and not_ready, "task ran despite no labeled node"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
