@@ -232,6 +232,17 @@ class Dataset:
             batch_size=batch_size, drop_last=drop_last, device=device,
             sharding=sharding, prefetch=prefetch, dtypes=dtypes)
 
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           drop_last: bool = False, device=None, dtypes=None,
+                           local_shuffle_buffer_size: Optional[int] = None,
+                           local_shuffle_seed: Optional[int] = None
+                           ) -> Iterator[Any]:
+        return self.iterator().iter_torch_batches(
+            batch_size=batch_size, drop_last=drop_last, device=device,
+            dtypes=dtypes,
+            local_shuffle_buffer_size=local_shuffle_buffer_size,
+            local_shuffle_seed=local_shuffle_seed)
+
     def take(self, limit: int = 20) -> List[Dict]:
         out = []
         for row in self.iter_rows():

@@ -80,6 +80,35 @@ class DataIterator:
         while queue:
             yield queue.popleft()
 
+    def iter_torch_batches(self, *, batch_size: Optional[int] = 256,
+                           drop_last: bool = False, device=None,
+                           dtypes=None,
+                           local_shuffle_buffer_size: Optional[int] = None,
+                           local_shuffle_seed: Optional[int] = None
+                           ) -> Iterator[Any]:
+        """Batches as dict[str, torch.Tensor] (reference:
+        data/iterator.py iter_torch_batches) — numeric columns become
+        tensors (optionally moved to ``device`` / cast via ``dtypes``),
+        object columns stay numpy."""
+        import torch
+
+        rng = np.random.default_rng(local_shuffle_seed)
+        for block in _rebatch(self._iter_blocks(), batch_size, drop_last,
+                              local_shuffle_buffer_size, rng):
+            batch = BlockAccessor.to_numpy_block(block)
+            out = {}
+            for k, v in batch.items():
+                if v.dtype.kind == "O":
+                    out[k] = v
+                    continue
+                t = torch.from_numpy(np.ascontiguousarray(v))
+                if dtypes and k in dtypes:
+                    t = t.to(dtypes[k])
+                if device is not None:
+                    t = t.to(device)
+                out[k] = t
+            yield out
+
     def materialize(self):
         blocks = list(self._iter_blocks())
         from ray_tpu.data import from_blocks

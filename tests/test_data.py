@@ -402,3 +402,22 @@ def test_pandas_native_blocks(cluster):
     rows = ray_tpu.data.from_pandas(df) \
         .map_batches(assign, batch_size=6, batch_format="pandas").take_all()
     assert all(r["y"] is not None and r["y"] == r["y"] for r in rows)
+
+
+def test_iter_torch_batches(cluster):
+    """Torch-tensor batch iteration (reference: iterator iter_torch_batches);
+    torch in this image is CPU-only, which is exactly the env-runner /
+    preprocessing role it plays in a TPU cluster."""
+    torch = pytest.importorskip("torch")
+
+    ds = ray_tpu.data.range(100).map(lambda r: {"id": r["id"],
+                                                "x": float(r["id"]) * 0.5})
+    seen = 0
+    for batch in ds.iter_torch_batches(batch_size=32,
+                                       dtypes={"x": torch.float32}):
+        assert isinstance(batch["id"], torch.Tensor)
+        assert batch["x"].dtype == torch.float32
+        torch.testing.assert_close(batch["x"],
+                                   batch["id"].to(torch.float32) * 0.5)
+        seen += len(batch["id"])
+    assert seen == 100
