@@ -63,12 +63,21 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
-    """Best-effort cancel of a pending task (reference: ray.cancel)."""
-    # Cooperative cancellation arrives with the task-manager milestone; the
-    # call is accepted so callers are portable.
-    import warnings
+    """Cancel the task producing ``ref`` (reference: ray.cancel).
 
-    warnings.warn("ray_tpu.cancel is currently a no-op", stacklevel=2)
+    Pending tasks fail with TaskCancelledError (dep-blocked tasks are
+    caught at dispatch time); running tasks get a cooperative in-thread
+    raise on their worker (delivered at the next Python bytecode —
+    blocking C calls defer it), and ``force=True`` exits the worker
+    process instead.  Cancelled tasks are never retried.  Finished tasks
+    are a no-op; actor tasks raise ValueError.
+
+    Caveats vs the reference: ``recursive`` does not yet propagate to
+    tasks the cancelled task itself spawned; ``force=True`` exits the
+    whole worker process, so unrelated tasks pipelined onto the same
+    worker are re-queued (retried) — avoid force-cancel around
+    non-idempotent work."""
+    _worker.require_core().cancel(ref, force=force, recursive=recursive)
 
 
 def nodes() -> list:

@@ -115,6 +115,13 @@ class CoreWorker:
         self._node_id_hex = node_id.hex() if node_id else None
         self._pid = os.getpid()
         self._race_guard = None  # set when the race detector wraps an actor
+        # task cancellation (executor side): ids cancelled before start +
+        # the thread currently running each normal task
+        self._cancelled_exec: set = set()
+        self._running_threads: Dict[bytes, int] = {}
+        # driver side: tasks the user cancelled (suppresses retry-on-death
+        # when force-cancel kills the worker mid-task)
+        self._cancelled_tasks: set = set()
         self.session_dir = session_dir
         self.namespace = namespace
         self.job_id = JobID.from_int(0)
@@ -942,6 +949,30 @@ class CoreWorker:
         logger.info("worker exiting on request")
         os._exit(0)
 
+    async def rpc_cancel_task(self, conn, msg):
+        """Cooperative cancel of one normal task on this worker (reference:
+        CoreWorker::HandleCancelTask raising in the executing thread).  A
+        queued task is marked and never starts; a RUNNING task gets
+        TaskCancelledError raised at its thread's next bytecode boundary
+        (PyThreadState_SetAsyncExc — blocking C calls like time.sleep defer
+        delivery until they return; force=True kills the worker instead)."""
+        import ctypes
+
+        tkey = msg["task_id"]
+        if len(self._cancelled_exec) >= 4096:
+            # bound the marker set: a cancel that raced its completion would
+            # otherwise leave its 24-byte key behind forever
+            self._cancelled_exec.pop()
+        self._cancelled_exec.add(tkey)
+        tid = self._running_threads.get(tkey)
+        if tid is not None:
+            # microscopic race: the thread may finish between the lookup and
+            # the raise, delivering onto its next task — same caveat the
+            # reference's in-thread cancellation carries
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(tid), ctypes.py_object(TaskCancelledError))
+        return True
+
     # ========================================================= task submission
     def _child_trace(self) -> tuple:
         """(trace_id, span_id, parent_span_id) for a task submitted from
@@ -1090,6 +1121,66 @@ class CoreWorker:
         self.emit_task_event(spec, "SUBMITTED")
         self._actor_submitter(actor_id).enqueue(spec, holds)
         return refs
+
+    def cancel(self, ref: ObjectRef, force: bool = False,
+               recursive: bool = False) -> None:
+        """Cancel the task that produces ``ref`` (reference: ray.cancel /
+        CoreWorker::CancelTask).  Pending tasks are failed locally with
+        TaskCancelledError; running tasks get a cooperative in-thread raise
+        on their worker, or the worker is told to exit with ``force=True``.
+        Finished/unknown tasks are a no-op; actor tasks are unsupported."""
+        self.io.run(self._cancel_async(ref, force))
+
+    async def _cancel_async(self, ref: ObjectRef, force: bool) -> None:
+        task_id = ref.oid.task_id()
+        tkey = task_id.binary()
+        err = TaskCancelledError(f"task {task_id.hex()} was cancelled")
+        for sub in self.actor_submitters.values():
+            if tkey in sub._inflight or any(
+                    item[0].task_id == task_id
+                    for item in list(getattr(sub, "_queue", ()))):
+                raise ValueError(
+                    "ray_tpu.cancel does not support actor tasks "
+                    "(reference parity: use ray.kill for actors)")
+        sub = self.submitter
+        # 1. staged (never left the caller-side queue)
+        with sub._stage_lock:
+            for item in list(sub._stage):
+                if item[0].task_id == task_id:
+                    sub._stage.remove(item)
+                    self.fail_task(item[0], err, item[1])
+                    return
+        # 2. pending in a lease class (waiting for a worker)
+        for st in sub.classes.values():
+            for item in list(st["pending"]):
+                if item[0].task_id == task_id:
+                    st["pending"].remove(item)
+                    self.fail_task(item[0], err, item[1])
+                    return
+        # 3. dispatched: signal the worker that runs it
+        if tkey in self._completion_router:
+            self._cancelled_tasks.add(tkey)
+            for conn, tasks in list(self._conn_tasks.items()):
+                if tkey in tasks:
+                    try:
+                        if force:
+                            # hard stop: the worker process exits; the lost
+                            # completion resolves as cancelled, not a retry
+                            await conn.notify("exit_worker", {})
+                        else:
+                            await conn.notify("cancel_task",
+                                              {"task_id": tkey})
+                    except (rpc.ConnectionLost, ConnectionError):
+                        pass
+                    return
+        if self.memory_store.known(ref.oid) and \
+                not self.memory_store.contains(ref.oid):
+            # still pending but in none of the scannable queues: it is
+            # dep-blocked inside a submit() coroutine — leave a marker the
+            # dispatch choke point (_pump) honors once the deps resolve
+            self._cancelled_tasks.add(tkey)
+            return
+        # finished or foreign: no-op (reference behavior)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True):
         self.io.run(self.gcs_conn.call("kill_actor", {
@@ -1366,25 +1457,49 @@ class CoreWorker:
         run = {"items": chunk, "next": 0, "cur_start": None, "done": False}
         lock = threading.Lock()
 
+        def deliver(spec, fut, result):
+            # absorb a stray async cancellation raise landing exactly here:
+            # the completion must reach the loop or the caller hangs
+            while True:
+                try:
+                    loop.call_soon_threadsafe(
+                        self._complete_chunk_item, spec, fut, result)
+                    return
+                except TaskCancelledError:
+                    continue
+
         def body():
             while True:
-                with lock:
-                    if run["next"] >= len(run["items"]):
-                        return
-                    item = run["items"][run["next"]]
-                    run["next"] += 1
-                    run["cur_start"] = time.monotonic()
-                spec, fut = item
-                # thread-safe deque append: RUNNING is visible while the
-                # task executes, not backdated at completion
-                self.emit_task_event(spec, "RUNNING")
                 try:
+                    with lock:
+                        if run["next"] >= len(run["items"]):
+                            return
+                        item = run["items"][run["next"]]
+                        run["next"] += 1
+                        run["cur_start"] = time.monotonic()
+                except TaskCancelledError:
+                    continue  # stray cancel raise between items: no item held
+                spec, fut = item
+                result = None
+                try:
+                    # thread-safe deque append: RUNNING is visible while the
+                    # task executes, not backdated at completion
+                    self.emit_task_event(spec, "RUNNING")
                     result = self._invoke_normal_sync(spec)
-                except BaseException as e:  # never kill the chunk
-                    result = {"status": "error", "error": pickle.dumps(
-                        RayTaskError.from_exception(spec.name, e))}
-                loop.call_soon_threadsafe(
-                    self._complete_chunk_item, spec, fut, result)
+                except BaseException as e:  # never kill the chunk — incl. a
+                    # cancellation raise delivered outside the invoke proper
+                    result = {"status": "error",
+                              "cancelled": isinstance(e, TaskCancelledError),
+                              "error": pickle.dumps(
+                                  RayTaskError.from_exception(spec.name, e)
+                                  if not isinstance(e, TaskCancelledError)
+                                  else e)}
+                finally:
+                    if result is None:  # belt: a raise past both handlers
+                        result = {"status": "error", "error": pickle.dumps(
+                            RaySystemError("task result lost to a stray "
+                                           "cancellation race"))}
+                    deliver(spec, fut, result)
 
         def watchdog():
             if run["done"]:
@@ -1673,6 +1788,14 @@ class CoreWorker:
     def _invoke_normal_sync(self, spec: TaskSpec) -> dict:
         from ray_tpu import runtime_env as renv
 
+        tkey = spec.task_id.binary()
+        if tkey in self._cancelled_exec:
+            # cancelled while queued on this worker: never starts
+            self._cancelled_exec.discard(tkey)
+            return {"status": "error", "cancelled": True,
+                    "error": pickle.dumps(TaskCancelledError(
+                        f"task {spec.name} was cancelled before it started"))}
+        self._running_threads[tkey] = threading.get_ident()
         try:
             # Env applied around BOTH function load and invocation: cloudpickle
             # resolves by-reference functions at load time, so working_dir /
@@ -1684,9 +1807,15 @@ class CoreWorker:
                     return {"status": "error", "error": pickle.dumps(
                         RayTaskError.from_exception(spec.name, e))}
                 return self._invoke_sync(spec, fn)
+        except TaskCancelledError as e:
+            return {"status": "error", "cancelled": True,
+                    "error": pickle.dumps(e)}
         except BaseException as e:  # env setup itself failed
             return {"status": "error",
                     "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
+        finally:
+            self._running_threads.pop(tkey, None)
+            self._cancelled_exec.discard(tkey)
 
     def _create_actor_sync(self, spec: TaskSpec) -> dict:
         try:
@@ -1755,6 +1884,8 @@ class CoreWorker:
             else:
                 out = fn(*args, **kwargs)
             return self._pack_returns(spec, out)
+        except TaskCancelledError:
+            raise  # surfaces as a cancelled (non-retriable) completion
         except BaseException as e:
             return {"status": "error",
                     "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
@@ -1969,6 +2100,16 @@ class NormalTaskSubmitter:
         # leased-worker connections).
         depth = RayConfig.lease_pipeline_depth
         while st["pending"] and st["idle"]:
+            # cancel marker check at the dispatch choke point: covers tasks
+            # that were dep-blocked (invisible to _cancel_async's queue
+            # scans) when the user cancelled them
+            spec0 = st["pending"][0][0]
+            if spec0.task_id.binary() in self.cw._cancelled_tasks:
+                spec, holds = st["pending"].popleft()
+                self.cw._cancelled_tasks.discard(spec.task_id.binary())
+                self.cw.fail_task(spec, TaskCancelledError(
+                    f"task {spec.name} was cancelled"), holds)
+                continue
             lease = st["idle"].pop()
             if lease.get("returned"):
                 continue  # raced with _return_idle: worker no longer ours
@@ -2235,11 +2376,19 @@ class NormalTaskSubmitter:
                      item: dict) -> None:
         """Completion for one batched normal task (runs on the IO loop)."""
         worker_ok = True
+        # a resolved task consumes its cancel marker (win or lose): the sets
+        # must not grow forever under cancel-heavy workloads
+        tkey = spec.task_id.binary()
+        was_cancelled = tkey in self.cw._cancelled_tasks
+        self.cw._cancelled_tasks.discard(tkey)
         if item["status"] == "ok":
             self.cw.complete_task(spec, item["returns"], holds)
         elif item["status"] == "error":
             retriable = False
-            if spec.retry_exceptions and spec.attempt_number < spec.max_retries:
+            if spec.retry_exceptions and spec.attempt_number < spec.max_retries \
+                    and not item.get("cancelled"):
+                # an explicitly cancelled task never retries (reference:
+                # ray.cancel cancelled tasks are not retried)
                 retriable = True
             if retriable:
                 spec.attempt_number += 1
@@ -2252,7 +2401,11 @@ class NormalTaskSubmitter:
                            for oid in spec.return_ids()], holds)
         else:  # "lost": the worker connection died mid-task
             worker_ok = False
-            if spec.attempt_number < spec.max_retries:
+            if was_cancelled:
+                # force-cancel killed the worker: cancelled, never retried
+                self.cw.fail_task(spec, TaskCancelledError(
+                    f"task {spec.name} was cancelled (force)"), holds)
+            elif spec.attempt_number < spec.max_retries:
                 spec.attempt_number += 1
                 spec.span_id = _fast_unique(8).hex()  # span per attempt
                 logger.info("retrying task %s (attempt %d) after worker failure",

@@ -1,0 +1,119 @@
+"""Task cancellation (reference: python/ray/tests/test_cancel.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskCancelledError
+
+
+@pytest.fixture
+def cluster():
+    from conftest import ensure_shared_runtime
+
+    yield ensure_shared_runtime()
+
+
+def test_cancel_pending_task(cluster):
+    """A task stuck behind busy workers cancels without ever running."""
+
+    @ray_tpu.remote
+    def hold(t):
+        time.sleep(t)
+        return "done"
+
+    # saturate every CPU so the victim stays pending
+    blockers = [hold.remote(8) for _ in range(8)]
+    victim = hold.remote(0)
+    time.sleep(0.5)
+    ray_tpu.cancel(victim)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(victim, timeout=30)
+    # blockers unaffected
+    assert ray_tpu.get(blockers[0], timeout=60) == "done"
+
+
+def test_cancel_running_task_cooperative(cluster):
+    """A RUNNING pure-Python loop gets TaskCancelledError raised in-thread."""
+
+    @ray_tpu.remote
+    def spin():
+        x = 0
+        t0 = time.time()
+        while time.time() - t0 < 60:
+            x += 1  # bytecode-dense: async raise lands quickly
+        return x
+
+    ref = spin.remote()
+    time.sleep(1.5)  # let it start
+    t0 = time.time()
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    assert time.time() - t0 < 25, "cancel did not interrupt the loop"
+
+
+def test_cancel_force_kills_worker(cluster):
+    """force=True stops even a blocking-C task (time.sleep) by exiting the
+    worker; the task resolves cancelled, NOT retried despite max_retries."""
+
+    @ray_tpu.remote(max_retries=3)
+    def sleeper():
+        time.sleep(120)
+        return "never"
+
+    ref = sleeper.remote()
+    time.sleep(1.5)
+    ray_tpu.cancel(ref, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+
+
+def test_cancel_finished_task_is_noop(cluster):
+    @ray_tpu.remote
+    def quick():
+        return 7
+
+    ref = quick.remote()
+    assert ray_tpu.get(ref, timeout=60) == 7
+    ray_tpu.cancel(ref)  # no-op
+    assert ray_tpu.get(ref, timeout=30) == 7
+
+
+def test_cancel_actor_task_rejected(cluster):
+    @ray_tpu.remote
+    class A:
+        def slow(self):
+            time.sleep(5)
+            return 1
+
+    a = A.options(num_cpus=0.1).remote()
+    ref = a.slow.remote()
+    time.sleep(0.3)
+    with pytest.raises(ValueError, match="actor task"):
+        ray_tpu.cancel(ref)
+    assert ray_tpu.get(ref, timeout=60) == 1
+    ray_tpu.kill(a)
+
+
+def test_cancel_dep_blocked_task(cluster):
+    """A task waiting on an unresolved dependency is cancellable: the
+    marker is honored at dispatch time once the dependency resolves."""
+
+    @ray_tpu.remote
+    def slow_dep():
+        time.sleep(4)
+        return 1
+
+    @ray_tpu.remote
+    def child(x):
+        return x + 1
+
+    dep = slow_dep.remote()
+    victim = child.remote(dep)
+    time.sleep(0.5)
+    ray_tpu.cancel(victim)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(victim, timeout=60)
+    assert ray_tpu.get(dep, timeout=60) == 1  # the dep itself unaffected
