@@ -70,7 +70,10 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> No
     raise on their worker (delivered at the next Python bytecode —
     blocking C calls defer it), and ``force=True`` exits the worker
     process instead.  Cancelled tasks are never retried.  Finished tasks
-    are a no-op; actor tasks raise ValueError.
+    are a no-op.  Actor tasks: queued ones cancel immediately, running
+    ASYNC methods cancel via asyncio on the actor's worker, running sync
+    methods are best-effort (they complete) — the reference's
+    async-actor-only cancellation semantics.
 
     Caveats vs the reference: ``recursive`` does not yet propagate to
     tasks the cancelled task itself spawned; ``force=True`` exits the

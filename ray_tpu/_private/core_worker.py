@@ -41,8 +41,9 @@ import cloudpickle
 
 from ray_tpu._private import rpc
 from ray_tpu._private.config import RayConfig
-from ray_tpu._private.ids import (ActorID, JobID, NodeID, ObjectID, TaskID,
-                                  WorkerID, _fast_unique)
+from ray_tpu._private.ids import (ACTOR_ID_UNIQUE_BYTES, ActorID, JobID,
+                                  NodeID, ObjectID, TaskID, WorkerID,
+                                  _fast_unique)
 from ray_tpu._private.memory_store import IN_PLASMA, MemoryStore
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.object_store import PlasmaClient
@@ -119,6 +120,7 @@ class CoreWorker:
         # the thread currently running each normal task
         self._cancelled_exec: set = set()
         self._running_threads: Dict[bytes, int] = {}
+        self._running_async: Dict[bytes, "asyncio.Task"] = {}
         # driver side: tasks the user cancelled (suppresses retry-on-death
         # when force-cancel kills the worker mid-task)
         self._cancelled_tasks: set = set()
@@ -964,6 +966,10 @@ class CoreWorker:
             # otherwise leave its 24-byte key behind forever
             self._cancelled_exec.pop()
         self._cancelled_exec.add(tkey)
+        atask = self._running_async.get(tkey)
+        if atask is not None:
+            atask.cancel()  # async actor task: asyncio cancellation
+            return True
         tid = self._running_threads.get(tkey)
         if tid is not None:
             # microscopic race: the thread may finish between the lookup and
@@ -1128,7 +1134,9 @@ class CoreWorker:
         CoreWorker::CancelTask).  Pending tasks are failed locally with
         TaskCancelledError; running tasks get a cooperative in-thread raise
         on their worker, or the worker is told to exit with ``force=True``.
-        Finished/unknown tasks are a no-op; actor tasks are unsupported."""
+        Finished/unknown tasks are a no-op.  Actor tasks: queued cancel
+        immediately, running async methods cancel via asyncio, running
+        sync methods are best-effort (complete normally)."""
         self.io.run(self._cancel_async(ref, force))
 
     async def _cancel_async(self, ref: ObjectRef, force: bool) -> None:
@@ -1136,12 +1144,33 @@ class CoreWorker:
         tkey = task_id.binary()
         err = TaskCancelledError(f"task {task_id.hex()} was cancelled")
         for sub in self.actor_submitters.values():
-            if tkey in sub._inflight or any(
-                    item[0].task_id == task_id
-                    for item in list(getattr(sub, "_queue", ()))):
-                raise ValueError(
-                    "ray_tpu.cancel does not support actor tasks "
-                    "(reference parity: use ray.kill for actors)")
+            with sub._queue_lock:
+                for item in list(sub._queue):
+                    if item[0].task_id == task_id:
+                        sub._queue.remove(item)
+                        self.fail_task(item[0], err, item[1])
+                        return
+            if tkey in sub._inflight:
+                # async actor methods cancel via asyncio on the actor's
+                # worker; sync methods are best-effort (the marker stops a
+                # not-yet-started task, a running sync method completes) —
+                # mirrors the reference's async-only actor cancellation
+                if sub.conn is not None and not sub.conn.closed:
+                    try:
+                        await sub.conn.notify("cancel_task",
+                                              {"task_id": tkey})
+                    except (rpc.ConnectionLost, ConnectionError):
+                        pass
+                return
+        aid = task_id.actor_id()
+        is_actor_task = not aid.binary().startswith(
+            b"\xff" * ACTOR_ID_UNIQUE_BYTES)  # for_task embeds a nil actor
+        if is_actor_task and not self.memory_store.contains(ref.oid):
+            # an actor task caught in its submitter's _drain window (popped
+            # from _queue, not yet inflight): leave the marker _drain
+            # consumes at ship time
+            self._cancelled_tasks.add(tkey)
+            return
         sub = self.submitter
         # 1. staged (never left the caller-side queue)
         with sub._stage_lock:
@@ -1865,6 +1894,14 @@ class CoreWorker:
         return {"status": "ok", "returns": []}
 
     def _invoke_sync(self, spec: TaskSpec, fn) -> dict:
+        tkey = spec.task_id.binary()
+        if tkey in self._cancelled_exec:
+            # cancelled while queued on this worker (sync actor methods
+            # included): never starts
+            self._cancelled_exec.discard(tkey)
+            return {"status": "error", "cancelled": True,
+                    "error": pickle.dumps(TaskCancelledError(
+                        f"task {spec.name} was cancelled before it started"))}
         self.task_ctx.task_id = spec.task_id
         self.task_ctx.job_id = spec.job_id
         self.task_ctx.task_name = spec.name
@@ -1895,10 +1932,39 @@ class CoreWorker:
 
     async def _invoke_async(self, spec: TaskSpec, method) -> dict:
         trace_token = _trace_ctx.set((spec.trace_id, spec.span_id))
+        tkey = spec.task_id.binary()
+        if tkey in self._cancelled_exec:
+            self._cancelled_exec.discard(tkey)
+            _trace_ctx.reset(trace_token)
+            return {"status": "error", "cancelled": True,
+                    "error": pickle.dumps(TaskCancelledError(
+                        f"task {spec.name} was cancelled before it started"))}
         try:
             loop = asyncio.get_event_loop()
             args, kwargs = await loop.run_in_executor(None, self._resolve_args, spec)
-            out = await method(*args, **kwargs)
+            # async actor tasks are cancellable (reference: asyncio-actor
+            # cancellation): register so rpc_cancel_task can .cancel() us
+            self._running_async[tkey] = asyncio.current_task()
+            if tkey in self._cancelled_exec:
+                # cancel landed while _resolve_args ran (pre-registration
+                # window): honor it before starting the method
+                self._running_async.pop(tkey, None)
+                self._cancelled_exec.discard(tkey)
+                return {"status": "error", "cancelled": True,
+                        "error": pickle.dumps(TaskCancelledError(
+                            f"task {spec.name} was cancelled"))}
+            try:
+                out = await method(*args, **kwargs)
+            except asyncio.CancelledError:
+                cur = asyncio.current_task()
+                if cur is not None and hasattr(cur, "uncancel"):
+                    cur.uncancel()  # absorb: the loop task must survive
+                return {"status": "error", "cancelled": True,
+                        "error": pickle.dumps(TaskCancelledError(
+                            f"actor task {spec.name} was cancelled"))}
+            finally:
+                self._running_async.pop(tkey, None)
+                self._cancelled_exec.discard(tkey)
             # _pack_returns can block on plasma.put (large returns) — must not
             # run on the IO loop it would be waiting on.
             return await loop.run_in_executor(None, self._pack_returns, spec, out)
@@ -2503,16 +2569,27 @@ class ActorTaskSubmitter:
                 with self._queue_lock:
                     self._queue.extendleft(reversed(items))
                 continue
+            shipped = []
             for spec, holds in items:
                 tkey = spec.task_id.binary()
+                if tkey in self.cw._cancelled_tasks:
+                    # cancelled while this batch waited for the actor to
+                    # come alive (the _drain window)
+                    self.cw._cancelled_tasks.discard(tkey)
+                    self.cw.fail_task(spec, TaskCancelledError(
+                        f"task {spec.name} was cancelled"), holds)
+                    continue
                 self._inflight[tkey] = (spec, holds)
                 self.cw._completion_router[tkey] = (
                     lambda item, s=spec, h=holds: self._complete(s, h, item))
+                shipped.append((spec, holds))
+            if not shipped:
+                continue
             conn = self.conn
             try:
                 await conn.notify(
                     "push_task_batch",
-                    pickle.dumps([spec for spec, _ in items]))
+                    pickle.dumps([spec for spec, _ in shipped]))
             except (rpc.ConnectionLost, ConnectionError):
                 # the close callback retries/fails every inflight (incl. this
                 # batch); nothing more to do here
@@ -2520,6 +2597,7 @@ class ActorTaskSubmitter:
 
     def _complete(self, spec: TaskSpec, holds, item: dict) -> None:
         tkey = spec.task_id.binary()
+        self.cw._cancelled_tasks.discard(tkey)  # consume any stale marker
         if self._inflight.pop(tkey, None) is None:
             return  # already failed via death notification
         if item["status"] == "ok":

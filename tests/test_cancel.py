@@ -81,19 +81,36 @@ def test_cancel_finished_task_is_noop(cluster):
     assert ray_tpu.get(ref, timeout=30) == 7
 
 
-def test_cancel_actor_task_rejected(cluster):
+def test_cancel_async_actor_task(cluster):
+    """Async actor tasks cancel via asyncio on the actor's worker
+    (reference: async-actor cancellation); a RUNNING sync method is
+    best-effort and completes."""
+    import asyncio
+
     @ray_tpu.remote
     class A:
-        def slow(self):
-            time.sleep(5)
+        async def stuck(self):
+            await asyncio.sleep(120)
+            return "never"
+
+        def slow_sync(self):
+            time.sleep(3)
             return 1
 
     a = A.options(num_cpus=0.1).remote()
-    ref = a.slow.remote()
-    time.sleep(0.3)
-    with pytest.raises(ValueError, match="actor task"):
-        ray_tpu.cancel(ref)
-    assert ray_tpu.get(ref, timeout=60) == 1
+    ref = a.stuck.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=30)
+    # the actor survives and still serves calls
+    assert ray_tpu.get(a.slow_sync.remote(), timeout=60) == 1
+
+    # running SYNC actor method: best-effort — completes normally
+    ref2 = a.slow_sync.remote()
+    time.sleep(0.5)
+    ray_tpu.cancel(ref2)
+    assert ray_tpu.get(ref2, timeout=60) == 1
     ray_tpu.kill(a)
 
 
