@@ -1254,8 +1254,14 @@ class CoreWorker:
     # ----------------------------------------------- completion bookkeeping
     def complete_task(self, spec: TaskSpec, returns, holds: List[ObjectRef]):
         """Record task results into the owner memory store (runs on IO loop)."""
+        declared = {o.binary() for o in spec.return_ids()} \
+            if spec.num_returns == -1 else None
         for item in returns:
             oid = ObjectID(item[0])
+            if declared is not None and item[0] not in declared:
+                # dynamically created return: this driver owns it from now on
+                self.ref_counter.add_owned(oid, initial_local=0)
+                self.memory_store.register_pending(oid)
             kind = item[1]
             contained_meta = ()
             # force=True throughout: a reconstruction re-run's outcome must
@@ -1327,7 +1333,15 @@ class CoreWorker:
         self.io.spawn(_go())
 
     def fail_task(self, spec: TaskSpec, error: BaseException, holds: List[ObjectRef]):
-        for oid in spec.return_ids():
+        doomed = list(spec.return_ids())
+        if spec.num_returns == -1:
+            # dynamic generator: yielded oids aren't in return_ids(); any of
+            # them awaiting reconstruction must receive the error too or
+            # their getters hang forever
+            with self._refs_lock:
+                doomed += [oid for oid in self._recovery_inflight
+                           if oid.task_id() == spec.task_id]
+        for oid in doomed:
             with self._refs_lock:
                 self._recovery_inflight.discard(oid)
             # force=True: a reconstruction re-run's failure must overwrite the
@@ -1977,6 +1991,8 @@ class CoreWorker:
     def _pack_returns(self, spec: TaskSpec, out) -> dict:
         if spec.num_returns == 0:
             return {"status": "ok", "returns": []}
+        if spec.num_returns == -1:
+            return self._pack_dynamic_returns(spec, out)
         if spec.num_returns == 1:
             outs = [out]
         else:
@@ -1999,13 +2015,54 @@ class CoreWorker:
                 contained.append((cref.oid.binary(), cref.owner_addr(),
                                   cref.owner_worker_id()))
                 self._pin_returned_ref(cref, spec.task_id.binary())
-            if ser.total_bytes() > RayConfig.max_direct_call_object_size:
-                self.plasma.put_serialized(oid, ser)
-                returns.append((oid.binary(), "plasma", ser.total_bytes(),
-                                contained))
-            else:
-                returns.append((oid.binary(), "val", ser.inband,
-                                [bytes(b) for b in ser.buffers], contained))
+            returns.append(self._pack_one_return(oid, ser, contained))
+        return {"status": "ok", "returns": returns}
+
+    def _pack_one_return(self, oid: ObjectID, ser, contained) -> tuple:
+        """One return entry in the completion wire format (shared by fixed
+        and dynamic packing)."""
+        if ser.total_bytes() > RayConfig.max_direct_call_object_size:
+            self.plasma.put_serialized(oid, ser)
+            return (oid.binary(), "plasma", ser.total_bytes(), contained)
+        return (oid.binary(), "val", ser.inband,
+                [bytes(b) for b in ser.buffers], contained)
+
+    def _pack_dynamic_returns(self, spec: TaskSpec, out) -> dict:
+        """num_returns='dynamic': drain the generator; each yielded item
+        becomes its own caller-owned object (indices 1..N), and the primary
+        return (index 0) is the list of their (oid, owner) descriptors the
+        ObjectRefGenerator materializes driver-side (reference:
+        num_returns='dynamic' — refs available when the task completes)."""
+        returns = []
+        metas = []
+        put_in_plasma = []
+        try:
+            for i, value in enumerate(out):
+                oid = ObjectID.from_task(spec.task_id, i + 1)
+                ser = self.ctx.serialize(value)
+                if ser.contained_refs:
+                    raise ValueError(
+                        "ObjectRefs nested inside dynamically yielded "
+                        "values are not supported yet")
+                entry = self._pack_one_return(oid, ser, ())
+                if entry[1] == "plasma":
+                    put_in_plasma.append(oid)
+                returns.append(entry)
+                metas.append((oid.binary(), tuple(spec.owner_addr),
+                              spec.owner_worker_id))
+        except BaseException:
+            # mid-generation failure: already-written plasma copies would
+            # otherwise leak until job end (the owner never learns of them)
+            for oid in put_in_plasma:
+                try:
+                    self.plasma.free([oid])
+                except Exception:
+                    pass
+            raise
+        primary = spec.return_ids()[0]
+        pser = self.ctx.serialize(metas)
+        returns.append((primary.binary(), "val", pser.inband,
+                        [bytes(b) for b in pser.buffers], ()))
         return {"status": "ok", "returns": returns}
 
     def _pin_returned_ref(self, cref, token: bytes) -> None:

@@ -99,3 +99,43 @@ class ObjectRef:
 
 def _reconstruct_ref(oid_b: bytes, owner_addr, owner_worker_id) -> ObjectRef:
     return ObjectRef(ObjectID(oid_b), owner_addr, owner_worker_id)
+
+
+class ObjectRefGenerator:
+    """Handle for ``num_returns="dynamic"`` tasks (reference:
+    ray._raylet.ObjectRefGenerator): iterating yields one ObjectRef per
+    value the remote generator produced.  Refs materialize when the task
+    COMPLETES (dynamic semantics); iteration therefore blocks on task
+    completion, then yields instantly.  If the generator is never
+    iterated, the yielded objects live until job end (no eager release)."""
+
+    def __init__(self, primary_ref: "ObjectRef"):
+        self._primary = primary_ref
+        self._refs = None
+
+    def _materialize(self, timeout=None):
+        if self._refs is None:
+            from ray_tpu._private import worker as worker_mod
+            from ray_tpu._private.ids import ObjectID
+
+            metas = worker_mod.get(self._primary, timeout=timeout)
+            self._refs = [ObjectRef(ObjectID(ob), addr, wid)
+                          for ob, addr, wid in metas]
+        return self._refs
+
+    def __iter__(self):
+        return iter(self._materialize())
+
+    def __len__(self):
+        return len(self._materialize())
+
+    def __getitem__(self, i):
+        return self._materialize()[i]
+
+    def completed(self, timeout=None) -> list:
+        """Block until the task finishes; returns the ref list."""
+        return list(self._materialize(timeout))
+
+    def __repr__(self):
+        n = len(self._refs) if self._refs is not None else "?"
+        return f"ObjectRefGenerator({self._primary!r}, n={n})"

@@ -106,6 +106,10 @@ class TaskSpec:
     parent_span_id: Optional[str] = None
 
     def return_ids(self) -> List[ObjectID]:
+        if self.num_returns == -1:
+            # dynamic generator: the declared return is the index-0 primary
+            # (the ref list); yielded items take indices 1..N at pack time
+            return [ObjectID.from_task(self.task_id, 0)]
         return [ObjectID.from_task(self.task_id, i) for i in range(self.num_returns)]
 
     def is_actor_task(self) -> bool:

@@ -37,6 +37,10 @@ class ActorMethod:
         self._num_returns = num_returns
 
     def options(self, num_returns: Optional[int] = None) -> "ActorMethod":
+        if num_returns in ("dynamic", "streaming"):
+            raise ValueError(
+                "num_returns='dynamic' is not supported for actor methods "
+                "yet; plain tasks support it")
         return ActorMethod(self._handle, self._name,
                            num_returns if num_returns is not None else self._num_returns)
 

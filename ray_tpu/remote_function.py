@@ -57,19 +57,33 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         opts = self._default_options
         core = worker_mod.require_core()
+        num_returns = opts["num_returns"]
+        if num_returns == "streaming":
+            raise ValueError(
+                "num_returns='streaming' (refs delivered as produced) is "
+                "not implemented; use num_returns='dynamic' — refs "
+                "materialize when the task completes")
+        if num_returns == "dynamic":
+            # dynamic generators (reference: num_returns="dynamic" —
+            # ObjectRefGenerator whose refs materialize when the task ends)
+            num_returns = -1
         refs = core.submit_task(
             self._function,
             args,
             kwargs,
             name=self._call_name,
-            num_returns=opts["num_returns"],
+            num_returns=num_returns,
             resources=dict(self._resources),
             strategy=self._strategy,
             max_retries=opts["max_retries"],
             retry_exceptions=opts["retry_exceptions"],
             runtime_env=opts["runtime_env"],
         )
-        if opts["num_returns"] == 1:
+        if num_returns == -1:
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(refs[0])
+        if num_returns == 1:
             return refs[0]
         return refs
 
