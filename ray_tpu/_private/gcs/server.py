@@ -162,6 +162,8 @@ class GcsServer:
         self.actors: Dict[ActorID, ActorInfo] = {}
         self.named_actors: Dict[Tuple[str, str], ActorID] = {}  # (namespace, name)
         self.kv: Dict[str, Dict[str, bytes]] = {}  # namespace -> {key: value}
+        # requester -> standing resource bundles (autoscaler sdk)
+        self.requested_resources: Dict[bytes, list] = {}
         self.object_dir: Dict[bytes, Set[bytes]] = {}  # oid binary -> {node_id binary}
         self.subscribers: Dict[str, Set[rpc.Connection]] = {}  # channel -> conns
         self.next_job = 1
@@ -431,6 +433,19 @@ class GcsServer:
         })
         return {"dead": False}
 
+    async def rpc_request_resources(self, conn, msg):
+        """Programmatic autoscaler demand (reference:
+        ray.autoscaler.sdk.request_resources / autoscaler.proto
+        RequestClusterResources): each requester's LATEST call replaces its
+        previous request; an empty bundle list withdraws it."""
+        requester = msg.get("requester") or b"default"
+        bundles = [dict(b) for b in (msg.get("bundles") or [])]
+        if bundles:
+            self.requested_resources[requester] = bundles
+        else:
+            self.requested_resources.pop(requester, None)
+        return True
+
     async def rpc_get_cluster_status(self, conn, msg):
         """Aggregate load view for the autoscaler (reference: the GCS
         autoscaler state service, autoscaler.proto:315 GetClusterStatus)."""
@@ -438,6 +453,10 @@ class GcsServer:
         for n in self.nodes.values():
             if n.alive:
                 demand.extend(n.pending_demand)
+        # standing programmatic requests (request_resources) are demand the
+        # autoscaler must hold capacity for, tasks or no tasks
+        for bundles in self.requested_resources.values():
+            demand.extend(dict(b) for b in bundles)
         # actors stuck pending for lack of resources are demand too
         for a in self.actors.values():
             if a.state == "PENDING_CREATION":
@@ -897,6 +916,9 @@ class GcsServer:
             await self._handle_actor_failure(info, "all actor handles went out of scope")
 
     async def _drop_holder_everywhere(self, holder: bytes):
+        # a dead client's standing resource request must die with it — the
+        # per-requester key means nobody else could ever withdraw it
+        self.requested_resources.pop(holder, None)
         for info in list(self.actors.values()):
             if holder in info.holders:
                 info.holders.discard(holder)

@@ -32,6 +32,10 @@ def method(**options):
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        if num_returns in ("dynamic", "streaming"):
+            raise ValueError(
+                "num_returns='dynamic' is not supported for actor methods "
+                "yet; plain tasks support it")
         self._handle = handle
         self._name = name
         self._num_returns = num_returns

@@ -223,6 +223,27 @@ class StandardAutoscaler:
         idle_names = {n["node_name"] for n in status["nodes"]
                       if n["alive"] and n["idle"]
                       and n.get("age_s", float("inf")) >= grace}
+
+        # Standing demand (request_resources) holds capacity: an idle node
+        # is only reapable if the remaining nodes still fit every pending
+        # bundle — otherwise held nodes would flap launch/idle/terminate.
+        demand = [dict(d) for d in status.get("pending_demand", [])]
+        for d in demand:
+            d.pop("_gang", None)
+
+        def demand_fits_without(doomed_name: str) -> bool:
+            if not demand:
+                return True
+            frees = [dict(n["available"]) for n in status["nodes"]
+                     if n["alive"] and n["node_name"] != doomed_name]
+            for req in demand:
+                for avail in frees:
+                    if _fits(avail, req):
+                        _consume(avail, req)
+                        break
+                else:
+                    return False
+            return True
         for nid in list(self._idle_since):
             if nid not in idle_names:
                 del self._idle_since[nid]
@@ -239,6 +260,8 @@ class StandardAutoscaler:
                     continue
                 first = self._idle_since.setdefault(name, now)
                 if now - first >= self.config.idle_timeout_s:
+                    if not demand_fits_without(name):
+                        continue  # this node covers standing demand
                     logger.info("autoscaler terminating idle node %s", nid)
                     self.provider.terminate_node(nid)
                     self.terminated += 1

@@ -148,3 +148,45 @@ def test_min_workers_and_binpack():
     scaler.update()
     # 6 x 2 CPU = 12 CPU -> 3 nodes of 4, capped at max_workers=3 (1 already up)
     assert len(provider.nodes) == 3
+
+
+def test_request_resources_standing_demand(scaled_cluster):
+    """autoscaler sdk (reference: ray.autoscaler.sdk.request_resources):
+    a standing request launches capacity with no tasks queued; withdrawing
+    it lets idle nodes reap."""
+    cluster, provider, _ = scaled_cluster
+    from ray_tpu.autoscaler.sdk import request_resources
+
+    config = AutoscalingConfig(
+        node_types={"cpu": NodeTypeConfig(resources={"CPU": 2.0},
+                                          max_workers=3)},
+        max_workers=3, idle_timeout_s=3.0, update_interval_s=0.5)
+    scaler = StandardAutoscaler(config, provider, _gcs_call)
+    scaler.launch_grace_s = 5.0  # reap quickly once withdrawn
+    scaler.start()
+    try:
+        request_resources(bundles=[{"CPU": 2.0}, {"CPU": 2.0}])
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if len(provider.non_terminated_nodes({})) >= 1:
+                break
+            time.sleep(0.5)
+        assert provider.non_terminated_nodes({}), \
+            "standing request never scaled up"
+
+        # the contract: capacity is HELD with no tasks queued — the node
+        # must survive well past idle_timeout_s while the request stands
+        time.sleep(config.idle_timeout_s + 6)
+        assert provider.non_terminated_nodes({}), \
+            "held node was reaped while the request stood (flap)"
+
+        request_resources()  # withdraw
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            if not provider.non_terminated_nodes({}):
+                break
+            time.sleep(0.5)
+        assert not provider.non_terminated_nodes({}), \
+            "withdrawn request never reaped"
+    finally:
+        scaler.stop()
