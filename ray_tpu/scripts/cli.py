@@ -162,6 +162,57 @@ def _cmd_timeline(args) -> int:
     return 0
 
 
+def _cmd_memory(args) -> int:
+    """Per-node object-store summary (reference: `ray memory` /
+    memory_summary): capacity, usage, spill counters, object counts."""
+    import ray_tpu
+    from ray_tpu._private import rpc as _rpc
+    from ray_tpu.util import state
+
+    import asyncio
+
+    address = _resolve_address(args.address)
+    ray_tpu.init(address=address, ignore_reinit_error=True)
+    core = ray_tpu._private.worker.require_core()
+
+    alive = [n for n in core.gcs_call_sync("get_all_node_info") if n["alive"]]
+
+    async def info(addr):
+        # one bounded dial per node, all nodes concurrently: a wedged
+        # nodelet costs ~the timeout once, not once per node
+        conn = await _rpc.connect(*addr, name="memory->nodelet")
+        try:
+            return await conn.call("node_info", None, timeout=15)
+        finally:
+            await conn.close()
+
+    async def gather():
+        return await asyncio.gather(
+            *(info(tuple(n["addr"])) for n in alive), return_exceptions=True)
+
+    rows = []
+    for n, ni in zip(alive, core.io.run(gather())):
+        name = n["node_id"].hex()[:8]  # same id the state API prints
+        if isinstance(ni, BaseException):
+            rows.append((name, f"<unreachable: {ni}>"))
+            continue
+        st = ni["store"]
+        rows.append((
+            name,
+            f"{st['used']/2**20:8.1f} / {st['capacity']/2**20:8.1f} MiB  "
+            f"objects={st['num_objects']:<6} "
+            f"spilled={st['num_spilled']} ({st['bytes_spilled']/2**20:.1f} MiB)"))
+    print(f"{'node':<10} object store")
+    for name, desc in rows:
+        print(f"{name:<10} {desc}")
+    objs = state.list_objects()
+    print(f"\nobject directory: {len(objs)} cluster-visible objects")
+    if args.verbose:
+        for o in objs[:200]:
+            print(f"  {o['object_id'][:16]}  on {len(o['locations'])} node(s)")
+    return 0
+
+
 def _cmd_logs(args) -> int:
     """List/tail log files across the cluster (reference:
     python/ray/_private/log_monitor.py + `ray logs` in scripts.py)."""
@@ -270,6 +321,13 @@ def main(argv=None) -> int:
     p.add_argument("--address", default=None)
     p.add_argument("--output", default=None)
     p.set_defaults(fn=_cmd_timeline)
+
+    p = sub.add_parser("memory",
+                       help="per-node object-store usage + spill counters")
+    p.add_argument("--address", default=None)
+    p.add_argument("--verbose", action="store_true",
+                   help="also list cluster-visible object ids")
+    p.set_defaults(fn=_cmd_memory)
 
     p = sub.add_parser("logs", help="list or tail cluster log files")
     p.add_argument("filename", nargs="?", default=None,
