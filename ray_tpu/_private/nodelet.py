@@ -463,6 +463,24 @@ class Nodelet:
     def _log_dir(self) -> str:
         return os.path.join(self.session_dir, "logs")
 
+    async def rpc_list_workers(self, conn, msg):
+        """This node's worker processes (reference: util/state list_workers
+        — worker id, pid, state, actor binding, env pool, uptime)."""
+        now = time.monotonic()
+        out = []
+        for w in self.workers.values():
+            out.append({
+                "worker_id": w.worker_id.hex() if hasattr(w.worker_id, "hex")
+                else bytes(w.worker_id).hex(),
+                "pid": w.pid,
+                "state": w.state,
+                "is_actor": w.is_actor,
+                "actor_id": w.actor_id.hex() if w.actor_id else None,
+                "env_key": w.env_key,
+                "uptime_s": round(now - w.started_at, 1),
+            })
+        return out
+
     async def rpc_list_log_files(self, conn, msg):
         """Names + sizes of this node's log files (worker stdout/stderr,
         nodelet/gcs logs) — the `ray logs` surface (reference:

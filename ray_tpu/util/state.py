@@ -115,6 +115,23 @@ def _nodelet_call(node_id: Optional[str], method: str, msg=None):
     return core.io.run(call())
 
 
+def list_workers(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Worker processes on one node — or, with ``node_id=None``, across
+    every alive node (reference: util/state/api.py list_workers)."""
+    if node_id is not None:
+        return _nodelet_call(node_id, "list_workers")
+    out = []
+    for n in list_nodes():
+        if n["state"] != "ALIVE":
+            continue
+        try:
+            for w in _nodelet_call(n["node_id"], "list_workers"):
+                out.append({**w, "node_id": n["node_id"]})
+        except Exception:
+            continue
+    return out
+
+
 def list_logs(node_id: Optional[str] = None) -> List[Dict[str, Any]]:
     """Log files on one node (worker stdout, nodelet/gcs logs) — the
     ``ray logs`` surface (reference: python/ray/_private/log_monitor.py,

@@ -188,3 +188,24 @@ def test_trace_spans_propagate_through_nesting(ray_start_regular):
     t2, _s, _n, _a = ray_tpu.get(outer.remote(), timeout=60)
     assert t2 != trace_id
     ray_tpu.kill(leaf)
+
+
+def test_list_workers(ray_start_regular):
+    """list_workers (reference: util/state list_workers): live worker
+    processes with pid/state, actors flagged with their actor id."""
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    class Held:
+        def ping(self):
+            return 1
+
+    a = Held.options(num_cpus=0.1).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+    workers = state.list_workers()
+    assert workers and all("pid" in w and "state" in w for w in workers)
+    actors = [w for w in workers if w["is_actor"]]
+    assert actors, workers
+    assert any(w["actor_id"] for w in actors)
+    assert all(w["node_id"] for w in workers)
+    ray_tpu.kill(a)
