@@ -73,6 +73,15 @@ class CompiledDAGRef:
                 v.raise_()
         return value
 
+    def __await__(self):
+        """``await ref`` from asyncio code (reference: CompiledDAGFuture):
+        the blocking channel read runs on a worker thread so the event loop
+        stays live."""
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        return loop.run_in_executor(None, self.get).__await__()
+
 
 class CompiledDAG:
     def __init__(self, output_node: DAGNode, max_buf: int = 1 << 20,
@@ -89,9 +98,13 @@ class CompiledDAG:
         self._partial: List[Any] = []  # mid-row reads surviving a timeout
         self._is_multi = False
         self._loop_refs = []
+        import threading
         import uuid
 
         self._dag_uid = uuid.uuid4().hex[:12]  # KV keys must not collide
+        # concurrent awaiters (execute_async) drain results from threads;
+        # the in-order channel reads must be serialized
+        self._result_lock = threading.Lock()
         self._seq = 0
         self._drained = -1
         self._results: Dict[int, Any] = {}
@@ -286,6 +299,19 @@ class CompiledDAG:
         self._seq += 1
         return ref
 
+    async def execute_async(self, value: Any = None,
+                            timeout: Optional[float] = None
+                            ) -> "CompiledDAGRef":
+        """Asyncio-native execute (reference: CompiledDAG.execute_async):
+        input-channel backpressure waits on a worker thread, and the
+        returned ref is awaitable (``result = await ref``)."""
+        import asyncio
+        import functools as _ft
+
+        loop = asyncio.get_event_loop()
+        return await loop.run_in_executor(
+            None, _ft.partial(self.execute, value, timeout))
+
     def _ensure_out_channels(self):
         """Each final edge's driver endpoint: eager for shm; for a tcp edge
         the producer actor registers the rendezvous when its loop starts, so
@@ -303,6 +329,10 @@ class CompiledDAG:
         forward, buffering values for refs fetched out of order.  A
         MultiOutputNode graph yields a list, one element per member."""
         outs = self._ensure_out_channels()
+        with self._result_lock:
+            return self._result_for_locked(seq, timeout, outs)
+
+    def _result_for_locked(self, seq, timeout, outs):
         if seq <= self._drained and seq not in self._results:
             raise RuntimeError(
                 f"result for execute #{seq} was already consumed")

@@ -257,3 +257,27 @@ def test_compiled_multi_output_error_propagates(cluster):
         compiled.teardown()
     for h in (a, b):
         ray_tpu.kill(h)
+
+
+def test_compiled_execute_async(cluster):
+    """execute_async + awaitable refs (reference: CompiledDAG.execute_async
+    / CompiledDAGFuture) — a serving-style asyncio loop drives the
+    compiled pipeline without blocking its event loop."""
+    import asyncio
+
+    a = _Stage.options(num_cpus=0.1).remote(1)
+    b = _Stage.options(num_cpus=0.1).remote(10)
+    with InputNode() as inp:
+        dag = b.add.bind(a.add.bind(inp))
+    compiled = dag.experimental_compile()
+    try:
+        async def serve_loop():
+            refs = [await compiled.execute_async(i) for i in range(6)]
+            return await asyncio.gather(*refs)
+
+        out = asyncio.run(serve_loop())
+        assert out == [i + 11 for i in range(6)]
+    finally:
+        compiled.teardown()
+    for h in (a, b):
+        ray_tpu.kill(h)
