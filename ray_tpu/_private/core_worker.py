@@ -124,6 +124,10 @@ class CoreWorker:
         # driver side: tasks the user cancelled (suppresses retry-on-death
         # when force-cancel kills the worker mid-task)
         self._cancelled_tasks: set = set()
+        # GC-safe release pipeline: ObjectRef.__del__ only appends here
+        # (deque ops are reentrancy-safe); the IO loop drains
+        self._release_queue: deque = deque()
+        self._release_scheduled = False
         self.session_dir = session_dir
         self.namespace = namespace
         self.job_id = JobID.from_int(0)
@@ -807,16 +811,46 @@ class CoreWorker:
         self.ref_counter.add_local(ref.oid, ref.owner_addr(), ref.owner_worker_id())
 
     def deregister_ref(self, ref: ObjectRef) -> None:
+        """Called from ObjectRef.__del__ — i.e. potentially from the GARBAGE
+        COLLECTOR, reentrantly inside ANY allocation site, including one
+        that already holds the ref-counter lock (observed: gc fired inside
+        add_owned and remove_local self-deadlocked the non-reentrant lock).
+        __del__ therefore never does synchronous release work: the oid is
+        queued (deque appends are GC-safe) and drained outside GC context."""
         if self._shut:
             return
-        self.ref_counter.remove_local(ref.oid)
-        if not self.ref_counter.has(ref.oid):
-            self.plasma.release(ref.oid)
-            owner = ref.owner_worker_id()
-            if owner is not None and owner != self.worker_id.binary():
-                # Borrowed value cached by _resolve_one: drop with the last ref
-                # (owned entries are dropped by _on_out_of_scope instead).
-                self.memory_store.delete(ref.oid)
+        self._release_queue.append((ref.oid, ref.owner_worker_id()))
+        if not self._release_scheduled:
+            # schedule at most one drain per burst; the IO loop is never
+            # inside the ref-counter lock
+            self._release_scheduled = True
+            try:
+                self.io.loop.call_soon_threadsafe(self._drain_releases)
+            except RuntimeError:
+                self._release_scheduled = False  # loop closed: shutdown path
+
+    def _drain_releases(self) -> None:
+        """Run deferred ObjectRef releases (on the IO loop, outside GC).
+        Chunked: a huge GC burst must not stall every RPC connection for
+        the whole queue — drain a slice, then yield the loop."""
+        self._release_scheduled = False
+        for _ in range(1024):
+            try:
+                oid, owner = self._release_queue.popleft()
+            except IndexError:
+                return
+            if self._shut:
+                return
+            self.ref_counter.remove_local(oid)
+            if not self.ref_counter.has(oid):
+                self.plasma.release(oid)
+                if owner is not None and owner != self.worker_id.binary():
+                    # Borrowed value cached by _resolve_one: drop with the
+                    # last ref (owned entries drop via _on_out_of_scope).
+                    self.memory_store.delete(oid)
+        if self._release_queue and not self._release_scheduled:
+            self._release_scheduled = True
+            self.io.loop.call_soon(self._drain_releases)
 
     def _on_out_of_scope(self, oid: ObjectID) -> None:
         """Owner-side free: reclaim the value everywhere (reference: distributed

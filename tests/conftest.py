@@ -24,6 +24,25 @@ os.environ["PYTHONPATH"] = os.pathsep.join(
 # every node they spawn get them via this inherited env override
 os.environ["RAY_TPU_TEST_HOOKS"] = "1"
 
+# Hang forensics: RAY_TPU_TEST_HANG_DUMP=<seconds> dumps every thread's
+# stack and exits if the suite stalls that long with no progress (the
+# watchdog is re-armed per test in the autouse fixture below).
+_HANG_DUMP_S = float(os.environ.get("RAY_TPU_TEST_HANG_DUMP", "0") or 0)
+_HANG_DUMP_FILE = None
+if _HANG_DUMP_S > 0:
+    import faulthandler
+
+    # a REAL file: pytest's capture machinery swallows sys.stderr, so a
+    # default-armed dump would vanish with the dying process
+    _HANG_DUMP_FILE = open(
+        os.environ.get("RAY_TPU_TEST_HANG_DUMP_FILE",
+                       "/tmp/ray_tpu_hang_dump.txt"), "a")
+    # startup (imports + collection + first runtime spin-up) gets a wider
+    # budget than a single test; the per-test fixture re-arms with
+    # _HANG_DUMP_S once tests start
+    faulthandler.dump_traceback_later(max(_HANG_DUMP_S * 3, 300.0),
+                                      exit=True, file=_HANG_DUMP_FILE)
+
 # FORCE cpu: tests must never touch the real chip — the virtual 8-device CPU
 # mesh is the test substrate, and a wedged/contended TPU tunnel must not hang
 # the suite.  (Env var alone is insufficient; see _private/platform.py.)
@@ -92,3 +111,15 @@ def pytest_sessionfinish(session, exitstatus):
         ray_tpu.shutdown()
     except Exception:
         pass
+
+
+@pytest.fixture(autouse=True)
+def _rearm_hang_watchdog():
+    """Re-arm the stall watchdog at every test boundary so the dump fires
+    only when ONE test exceeds the budget, not cumulative runtime."""
+    if _HANG_DUMP_S > 0:
+        import faulthandler
+
+        faulthandler.dump_traceback_later(_HANG_DUMP_S, exit=True,
+                                         file=_HANG_DUMP_FILE)
+    yield
