@@ -29,6 +29,10 @@ from ray_tpu.runtime_context import get_runtime_context
 
 __version__ = "0.1.0"
 
+# one-time warning flag for cancel(recursive=True) (unimplemented child
+# propagation); module-global so it fires once per process, not per call
+_warned_recursive_cancel = False
+
 
 def remote(*args, **kwargs):
     """The @remote decorator (reference: python/ray/_private/worker.py:3151).
@@ -80,6 +84,20 @@ def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> No
     whole worker process, so unrelated tasks pipelined onto the same
     worker are re-queued (retried) — avoid force-cancel around
     non-idempotent work."""
+    global _warned_recursive_cancel
+    if recursive and not _warned_recursive_cancel:
+        # once per process: the default is recursive=True for reference API
+        # compatibility, but child-task propagation is not implemented yet —
+        # say so instead of silently leaving children running
+        _warned_recursive_cancel = True
+        import warnings
+
+        warnings.warn(
+            "ray_tpu.cancel(recursive=True): cancellation does not yet "
+            "propagate to tasks spawned BY the cancelled task — only the "
+            "task producing this ref is cancelled (pass recursive=False "
+            "to silence this warning)",
+            UserWarning, stacklevel=2)
     _worker.require_core().cancel(ref, force=force, recursive=recursive)
 
 

@@ -117,8 +117,13 @@ class CoreWorker:
         self._pid = os.getpid()
         self._race_guard = None  # set when the race detector wraps an actor
         # task cancellation (executor side): ids cancelled before start +
-        # the thread currently running each normal task
+        # the thread currently running each normal task.  The set gives O(1)
+        # membership on the execution hot path; the deque remembers insertion
+        # order so the bound evicts the OLDEST marker, not an arbitrary one
+        # (a set.pop() bound could forget a still-pending cancel under a
+        # cancellation flood and let the task run).
         self._cancelled_exec: set = set()
+        self._cancelled_exec_order: deque = deque()
         self._running_threads: Dict[bytes, int] = {}
         self._running_async: Dict[bytes, "asyncio.Task"] = {}
         # driver side: tasks the user cancelled (suppresses retry-on-death
@@ -269,8 +274,39 @@ class CoreWorker:
         # complete_task doesn't leak the pinned object forever.
         self._return_pins: deque = deque()
         self.io.spawn(self._sweep_return_pins_loop())
-        if mode == "worker":
-            self.io.spawn(self._push_metrics_loop())
+        # Per-phase latency histogram for the task hot path (lazy init off
+        # the hot path would race; one Histogram up front is cheap).
+        from ray_tpu._private.metrics import (PHASE_SECONDS_BOUNDARIES,
+                                              Histogram)
+
+        self._phase_hist = Histogram(
+            "task_phase_seconds",
+            "task hot-path time per phase (driver submit -> result wake)",
+            boundaries=PHASE_SECONDS_BOUNDARIES)
+        # Both modes push: the DRIVER owns the submit/stage/wake phases, so
+        # without a driver push the phase breakdown never reaches the
+        # nodelet's Prometheus scrape.
+        self.io.spawn(self._push_metrics_loop())
+
+    def _mark_cancelled_exec(self, tkey: bytes) -> None:
+        """Record a cancelled-before-start marker, bounded to 4096 entries
+        with oldest-first eviction (a cancel that raced its completion would
+        otherwise leave its 24-byte key behind forever; evicting an ARBITRARY
+        entry instead could forget a still-pending cancel under a flood)."""
+        if tkey in self._cancelled_exec:
+            return
+        self._cancelled_exec.add(tkey)
+        self._cancelled_exec_order.append(tkey)
+        while len(self._cancelled_exec) > 4096 and self._cancelled_exec_order:
+            # order entries whose marker was already consumed (discarded at
+            # task start/finish) no longer count against the bound
+            self._cancelled_exec.discard(self._cancelled_exec_order.popleft())
+        if len(self._cancelled_exec_order) > 4 * 4096:
+            # consumed markers leave stale keys behind in the order deque;
+            # compact occasionally so it tracks the live set, not history
+            self._cancelled_exec_order = deque(
+                k for k in self._cancelled_exec_order
+                if k in self._cancelled_exec)
 
     # ------------------------------------------------------- task events
     def emit_task_event(self, spec: TaskSpec, state: str,
@@ -314,6 +350,52 @@ class CoreWorker:
             self._flush_scheduled = True
             self.io.spawn(self._flush_task_events_once())
 
+    def _observe_phases(self, spec: TaskSpec, item: dict) -> None:
+        """Fold the driver's and executor's phase stamps into per-phase
+        durations: observe each into the task_phase_seconds histogram and
+        ride one PHASES annotation down the task-event pipeline so the state
+        API / CLI profile can compute per-task percentiles.  Runs on the IO
+        loop when a completion lands; a few time.time()/dict ops per task —
+        cheap next to the two events the lifecycle already emits."""
+        wp = item.get("phases")
+        pt = spec.phase_ts
+        if wp is None or pt is None:
+            return
+        recv = time.time()
+        exec_start, exec_end, put_s = wp
+        submit = pt.get("submit", exec_start)
+        ser = pt.get("ser", 0.0)
+        ship = pt.get("ship", submit + ser)
+        # contiguous by construction: the six durations sum to recv - submit
+        # (modulo clamping of cross-process clock skew), so a profile's
+        # per-phase breakdown accounts for the whole observed round-trip
+        durs = {
+            "driver_serialize": ser,
+            "driver_stage": max(ship - submit - ser, 0.0),
+            "dispatch": max(exec_start - ship, 0.0),
+            "exec": max(exec_end - exec_start - put_s, 0.0),
+            "result_put": max(put_s, 0.0),
+            "result_wake": max(recv - exec_end, 0.0),
+        }
+        observe = self._phase_hist.observe
+        for phase, dur in durs.items():
+            observe(dur, {"phase": phase})
+        if not RayConfig.task_events_enabled:
+            return
+        self.emit_raw_event({
+            "task_id": spec.task_id.hex(),
+            "attempt": spec.attempt_number,
+            "name": spec.name,
+            "state": "PHASES",
+            "ts": recv,
+            "job_id": spec.job_id.hex(),
+            "type": spec.task_type.name,
+            "trace_id": spec.trace_id,
+            "span_id": spec.span_id,
+            "parent_span_id": spec.parent_span_id,
+            "phases": durs,
+        })
+
     async def _push_metrics_loop(self):
         """Push this worker's metrics (built-in + user-defined via
         ray_tpu.util.metrics) to the nodelet's scrape endpoint (reference:
@@ -321,7 +403,7 @@ class CoreWorker:
         from ray_tpu._private.metrics import default_registry
 
         interval = RayConfig.metrics_report_interval_ms / 1000.0
-        source = f"worker-{self.worker_id.hex()[:12]}"
+        source = f"{self.mode}-{self.worker_id.hex()[:12]}"
         while not self._shut:
             await asyncio.sleep(interval)
             try:
@@ -995,11 +1077,7 @@ class CoreWorker:
         import ctypes
 
         tkey = msg["task_id"]
-        if len(self._cancelled_exec) >= 4096:
-            # bound the marker set: a cancel that raced its completion would
-            # otherwise leave its 24-byte key behind forever
-            self._cancelled_exec.pop()
-        self._cancelled_exec.add(tkey)
+        self._mark_cancelled_exec(tkey)
         atask = self._running_async.get(tkey)
         if atask is not None:
             atask.cancel()  # async actor task: asyncio cancellation
@@ -1078,11 +1156,14 @@ class CoreWorker:
                     resources: Dict[str, float], strategy: SchedulingStrategy,
                     max_retries: int, retry_exceptions: bool = False,
                     runtime_env: Optional[dict] = None) -> List[ObjectRef]:
+        t_submit = time.time()
         blob, key = self._function_payload(fn)
         spec_args, kw_keys, holds = self._build_args(args, kwargs)
+        t_ser = time.time()
         task_id = TaskID.for_task(self.job_id)
         trace_id, span_id, parent_span = self._child_trace()
         spec = TaskSpec(
+            phase_ts={"submit": t_submit, "ser": t_ser - t_submit},
             task_id=task_id, job_id=self.job_id, task_type=TaskType.NORMAL_TASK,
             name=name, function_blob=blob, function_key=key, args=spec_args,
             kwargs_keys=kw_keys, num_returns=num_returns, resources=resources,
@@ -1141,10 +1222,13 @@ class CoreWorker:
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
                           *, num_returns: int = 1,
                           max_task_retries: int = 0) -> List[ObjectRef]:
+        t_submit = time.time()
         spec_args, kw_keys, holds = self._build_args(args, kwargs)
+        t_ser = time.time()
         task_id = TaskID.for_actor_task(actor_id)
         trace_id, span_id, parent_span = self._child_trace()
         spec = TaskSpec(
+            phase_ts={"submit": t_submit, "ser": t_ser - t_submit},
             task_id=task_id, job_id=self.job_id, task_type=TaskType.ACTOR_TASK,
             name=method_name, function_blob=None, function_key=None, args=spec_args,
             kwargs_keys=kw_keys, num_returns=num_returns, resources={},
@@ -1961,6 +2045,7 @@ class CoreWorker:
             # Runtime env is already active here: applied by _invoke_normal_sync
             # (leased task workers, save/restore) or permanently at actor
             # creation (dedicated workers).
+            t0 = time.time()
             args, kwargs = self._resolve_args(spec)
             if self._race_guard is not None and self.actor_instance is not None:
                 with self._race_guard(self.actor_instance,
@@ -1968,7 +2053,13 @@ class CoreWorker:
                     out = fn(*args, **kwargs)
             else:
                 out = fn(*args, **kwargs)
-            return self._pack_returns(spec, out)
+            t1 = time.time()
+            result = self._pack_returns(spec, out)
+            t2 = time.time()
+            # executor phase stamps: (exec_start_ts, done_ts, result_put_s);
+            # the caller folds them against its own submit/ship/recv stamps
+            result["phases"] = (t0, t2, t2 - t1)
+            return result
         except TaskCancelledError:
             raise  # surfaces as a cancelled (non-retriable) completion
         except BaseException as e:
@@ -1989,6 +2080,7 @@ class CoreWorker:
                         f"task {spec.name} was cancelled before it started"))}
         try:
             loop = asyncio.get_event_loop()
+            t0 = time.time()
             args, kwargs = await loop.run_in_executor(None, self._resolve_args, spec)
             # async actor tasks are cancellable (reference: asyncio-actor
             # cancellation): register so rpc_cancel_task can .cancel() us
@@ -2015,7 +2107,12 @@ class CoreWorker:
                 self._cancelled_exec.discard(tkey)
             # _pack_returns can block on plasma.put (large returns) — must not
             # run on the IO loop it would be waiting on.
-            return await loop.run_in_executor(None, self._pack_returns, spec, out)
+            t1 = time.time()
+            result = await loop.run_in_executor(
+                None, self._pack_returns, spec, out)
+            t2 = time.time()
+            result["phases"] = (t0, t2, t2 - t1)
+            return result
         except BaseException as e:
             return {"status": "error",
                     "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
@@ -2515,8 +2612,11 @@ class NormalTaskSubmitter:
                 self._normal_done(key, st, lease, spec, holds,
                                   {"status": "lost"})
             return
+        ship = time.time()
         for spec, holds in items:
             tkey = spec.task_id.binary()
+            if spec.phase_ts is not None:
+                spec.phase_ts["ship"] = ship
             self.cw._completion_router[tkey] = (
                 lambda item, s=spec, h=holds:
                 self._normal_done(key, st, lease, s, h, item))
@@ -2539,6 +2639,7 @@ class NormalTaskSubmitter:
         was_cancelled = tkey in self.cw._cancelled_tasks
         self.cw._cancelled_tasks.discard(tkey)
         if item["status"] == "ok":
+            self.cw._observe_phases(spec, item)
             self.cw.complete_task(spec, item["returns"], holds)
         elif item["status"] == "error":
             retriable = False
@@ -2550,6 +2651,9 @@ class NormalTaskSubmitter:
             if retriable:
                 spec.attempt_number += 1
                 spec.span_id = _fast_unique(8).hex()  # span per attempt
+                # fresh phase clock: the retry's stage/dispatch must not be
+                # measured from the ORIGINAL submission's stamps
+                spec.phase_ts = {"submit": time.time(), "ser": 0.0}
                 self.cw.emit_task_event(spec, "SUBMITTED")
                 st["pending"].append((spec, holds))
             else:
@@ -2565,6 +2669,7 @@ class NormalTaskSubmitter:
             elif spec.attempt_number < spec.max_retries:
                 spec.attempt_number += 1
                 spec.span_id = _fast_unique(8).hex()  # span per attempt
+                spec.phase_ts = {"submit": time.time(), "ser": 0.0}
                 logger.info("retrying task %s (attempt %d) after worker failure",
                             spec.name, spec.attempt_number)
                 self.cw.emit_task_event(spec, "SUBMITTED")
@@ -2676,6 +2781,10 @@ class ActorTaskSubmitter:
                 shipped.append((spec, holds))
             if not shipped:
                 continue
+            ship = time.time()
+            for spec, _ in shipped:
+                if spec.phase_ts is not None:
+                    spec.phase_ts["ship"] = ship
             conn = self.conn
             try:
                 await conn.notify(
@@ -2692,6 +2801,7 @@ class ActorTaskSubmitter:
         if self._inflight.pop(tkey, None) is None:
             return  # already failed via death notification
         if item["status"] == "ok":
+            self.cw._observe_phases(spec, item)
             self.cw.complete_task(spec, item["returns"], holds)
         else:
             self.cw.complete_task(
@@ -2717,6 +2827,7 @@ class ActorTaskSubmitter:
                     spec.attempt_number < max(spec.max_task_retries, 0):
                 spec.attempt_number += 1
                 spec.span_id = _fast_unique(8).hex()  # span per attempt
+                spec.phase_ts = {"submit": time.time(), "ser": 0.0}
                 with self._queue_lock:
                     self._queue.append((spec, holds))
                 retried = True

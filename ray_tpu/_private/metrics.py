@@ -19,6 +19,15 @@ _LabelKey = Tuple[Tuple[str, str], ...]
 
 _PUSH_TTL_S = 30.0  # dead workers' pushed series age out of the scrape
 
+# Bucket boundaries for task hot-path phase timings (task_phase_seconds):
+# sub-millisecond resolution at the bottom (serialize/stage run in tens of
+# microseconds) up to tens of seconds for long task bodies.  One shared
+# constant so driver, worker, and nodelet histograms merge into one metric.
+PHASE_SECONDS_BOUNDARIES = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
 
 def _escape_label(v: str) -> str:
     # prometheus text format: backslash, quote, newline must be escaped or

@@ -1103,6 +1103,7 @@ class Nodelet:
              | {type: infeasible}
         (reference: NodeManager::HandleRequestWorkerLease node_manager.cc:1794)
         """
+        t_req = time.monotonic()
         resources = msg.get("resources", {})
         strategy = msg.get("strategy", {})
         bundle = msg.get("bundle")
@@ -1200,6 +1201,7 @@ class Nodelet:
             finally:
                 if token:
                     self._lease_waiters.pop(token, None)
+        t_acquired = time.monotonic()
         env_key = msg.get("env_key") or ""
         if env_key:
             try:
@@ -1224,8 +1226,28 @@ class Nodelet:
         lease_id = self._lease_seq
         w.lease_id = lease_id
         self.leases[lease_id] = {"resources": resources, "bundle": bundle, "worker": w}
+        self._observe_lease_phases(t_req, t_acquired, time.monotonic())
         return {"type": "granted", "lease_id": lease_id,
                 "worker_addr": list(w.addr), "worker_id": w.worker_id}
+
+    def _observe_lease_phases(self, t_req: float, t_acquired: float,
+                              t_granted: float) -> None:
+        """Lease-grant timing into this node's task_phase_seconds histogram
+        (same metric name as the driver/worker phases, so one Prometheus
+        query covers the whole chain): lease_queue is time spent waiting for
+        resources, worker_pop is env prep + waiting for / booting a worker
+        process.  Per lease, not per task — pipelined tasks amortize it."""
+        if not hasattr(self, "_m_phase"):
+            from ray_tpu._private import metrics as M
+
+            self._m_phase = M.Histogram(
+                "task_phase_seconds",
+                "task hot-path time per phase (driver submit -> result wake)",
+                boundaries=M.PHASE_SECONDS_BOUNDARIES)
+        self._m_phase.observe(max(t_acquired - t_req, 0.0),
+                              {"phase": "lease_queue"})
+        self._m_phase.observe(max(t_granted - t_acquired, 0.0),
+                              {"phase": "worker_pop"})
 
     def _pump_queued_leases(self):
         n = len(self._queued_leases)

@@ -104,6 +104,13 @@ class TaskSpec:
     trace_id: Optional[str] = None
     span_id: Optional[str] = None
     parent_span_id: Optional[str] = None
+    # Phase clock: wall-clock stamps of the submission hot path, travelling
+    # with the spec so the executor's stamps and the driver's stamps land in
+    # one record.  Keys: "submit" (ts at .remote()), "ser" (arg+fn serialize
+    # duration), "ship" (ts the spec left the driver in a push frame).  The
+    # executor returns its own stamps in the completion item; the driver
+    # folds both into per-phase durations (see CoreWorker._observe_phases).
+    phase_ts: Optional[Dict[str, float]] = None
 
     def return_ids(self) -> List[ObjectID]:
         if self.num_returns == -1:

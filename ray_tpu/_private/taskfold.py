@@ -15,6 +15,19 @@ from typing import Any, Dict, List, Optional
 # not event order — fold by emission timestamp (rank breaks exact ties).
 _RANK = {"SUBMITTED": 0, "RUNNING": 1, "FAILED": 2, "FINISHED": 2}
 
+# Canonical order of the task hot-path phases (driver submit -> driver
+# wake).  Shared by the state API's timeline sub-slices, the OTLP export's
+# span events, and the CLI profile table so every surface renders the same
+# chain.  Durations in seconds, stamped by CoreWorker._observe_phases.
+PHASE_ORDER = (
+    "driver_serialize",  # arg + function payload serialization at .remote()
+    "driver_stage",      # staged in the driver before the push frame left
+    "dispatch",          # wire + nodelet dispatch + worker exec queue
+    "exec",              # user function body (incl. arg resolution)
+    "result_put",        # return-value serialization / plasma put
+    "result_wake",       # worker done -> completion landing at the driver
+)
+
 
 def fold_task_events(events, limit: int = 1000,
                      job_id: Optional[str] = None,
@@ -39,6 +52,17 @@ def fold_task_events(events, limit: int = 1000,
             "parent_span_id": ev.get("parent_span_id"),
             "state_ts": {},
         })
+        if ev["state"] == "PHASES":
+            # Phase-breakdown annotation emitted by the driver when the
+            # completion lands: merged into the row without disturbing the
+            # lifecycle state machine (it arrives after FINISHED).
+            if ev.get("phases"):
+                row.setdefault("phases", {}).update(ev["phases"])
+            # phases are only emitted for completions; if the lifecycle
+            # events were dropped (buffer cap), the row must still carry a
+            # terminal state for consumers
+            row.setdefault("state", "FINISHED")
+            continue
         row["state_ts"][ev["state"]] = ev["ts"]
         row["state"] = ev["state"]
         for k in ("node_id", "worker_id", "pid", "error", "attributes"):

@@ -16,6 +16,10 @@ aiohttp app serving
                               latest state + per-state timestamps; reference:
                               dashboard task table from GcsTaskManager)
     GET /api/task_summary   — {name: {state: count}}
+    GET /api/history        — ring buffer of periodic scrapes (~15 min at
+                              5 s): per-node cpu/mem/object-store fractions
+                              + task-state counts, rendered as sparklines
+                              on the page so past stalls stay visible
     GET /api/logs           — log files on a node   (?node_id=...)
     GET /api/log            — tail one log file     (?node_id=...&name=...)
 
@@ -70,6 +74,23 @@ function bar(frac) {
   return `<span class="barbox"><span class="bar" style="width:${pct}%"></span>` +
          `</span>${pct}%`;
 }
+function spark(values, ymax, color) {
+  // inline SVG sparkline; ymax pins the scale (fractions pin to 1.0 so a
+  // past spike keeps its true height), ymax=null autoscales (counts)
+  const vals = values.map(v => v == null ? 0 : v);
+  if (!vals.length) return '—';
+  const w = 160, h = 26;
+  const max = ymax || Math.max(...vals, 1e-9);
+  const step = w / Math.max(vals.length - 1, 1);
+  const pts = vals.map((v, i) =>
+    `${(i * step).toFixed(1)},` +
+    `${(h - 1 - Math.min(v / max, 1) * (h - 2)).toFixed(1)}`).join(' ');
+  const last = vals[vals.length - 1];
+  return `<svg width="${w}" height="${h}" style="vertical-align:middle">` +
+    `<polyline points="${pts}" fill="none" stroke="${color || '#4a7fd4'}" ` +
+    `stroke-width="1.3"/></svg> <span style="color:#888">` +
+    `${ymax ? Math.round(last * 100) + '%' : last}</span>`;
+}
 async function viewLog(nodeId, name) {
   const r = await fetch(`/api/log?node_id=${encodeURIComponent(nodeId)}` +
                         `&name=${encodeURIComponent(name)}`);
@@ -102,7 +123,7 @@ async function loadLogs(nodeId) {
 }
 async function load() {
   try {
-    const [nodes, metrics, actors, jobs, status, tasks, summary] =
+    const [nodes, metrics, actors, jobs, status, tasks, summary, history] =
       await Promise.all([
         fetch('/api/nodes').then(r => r.json()),
         fetch('/api/node_metrics').then(r => r.json()),
@@ -111,6 +132,7 @@ async function load() {
         fetch('/api/cluster_status').then(r => r.json()),
         fetch('/api/tasks?limit=100').then(r => r.json()),
         fetch('/api/task_summary').then(r => r.json()),
+        fetch('/api/history').then(r => r.json()),
       ]);
     let html = '<h2>Nodes</h2><table><tr><th>node</th><th>name</th>' +
       '<th>alive</th><th>CPU</th><th>mem</th><th>object store</th>' +
@@ -131,6 +153,35 @@ async function load() {
         `<td><a onclick="loadLogs('${n.node_id}')">browse</a></td></tr>`;
     }
     html += '</table>';
+    const samples = history.samples || [];
+    if (samples.length) {
+      const span = Math.round(samples.length * history.interval_s);
+      html += `<h2>History (last ${span}s, ${history.interval_s}s samples)` +
+        '</h2><table><tr><th>node</th><th>CPU</th><th>mem</th>' +
+        '<th>object store</th></tr>';
+      const nids = Object.keys(samples[samples.length - 1].nodes || {});
+      for (const nid of nids) {
+        const series = k => samples.map(s => (s.nodes[nid] || {})[k]);
+        html += `<tr><td>${esc(nid.slice(0, 8))}</td>` +
+          `<td>${spark(series('cpu_frac'), 1)}</td>` +
+          `<td>${spark(series('mem_frac'), 1, '#b8860b')}</td>` +
+          `<td>${spark(series('store_frac'), 1, '#7a4ad4')}</td></tr>`;
+      }
+      html += '</table>';
+      const stateSet = new Set();
+      samples.forEach(s => Object.keys(s.tasks || {}).forEach(
+        k => stateSet.add(k)));
+      if (stateSet.size) {
+        html += '<table><tr><th>task state</th><th>count over time</th></tr>';
+        const colors = {RUNNING: '#06c', FINISHED: '#070', FAILED: '#b00'};
+        for (const st of [...stateSet].sort()) {
+          html += `<tr><td class="state-${st}">${esc(st)}</td>` +
+            `<td>${spark(samples.map(s => (s.tasks || {})[st] || 0), null,
+                         colors[st])}</td></tr>`;
+        }
+        html += '</table>';
+      }
+    }
     html += `<h2>Pending demand</h2><p>${esc(JSON.stringify(status.pending_demand))}</p>`;
     html += '<h2>Task summary</h2><table><tr><th>task</th><th>states</th></tr>';
     for (const [name, states] of Object.entries(summary))
@@ -176,8 +227,11 @@ setInterval(load, 5000);
 
 
 class Dashboard:
-    def __init__(self, gcs_addr: Tuple[str, int]):
+    def __init__(self, gcs_addr: Tuple[str, int],
+                 history_interval_s: float = 5.0,
+                 history_window_s: float = 900.0):
         import threading
+        from collections import deque
 
         self.gcs_addr = gcs_addr
         self._conn = None
@@ -185,6 +239,13 @@ class Dashboard:
         # the page's first load fires several API calls concurrently; their
         # executor threads must not each build an EventLoopThread/connection
         self._conn_lock = threading.Lock()
+        # Time-series ring buffer: one sample per scrape interval, ~15 min
+        # deep by default, so a stall that ended minutes ago is still
+        # VISIBLE on the page (the instantaneous view forgets it instantly).
+        self.history_interval_s = history_interval_s
+        self._history = deque(
+            maxlen=max(int(history_window_s / history_interval_s), 2))
+        self._history_task = None
 
     def _call(self, method: str, msg=None):
         from ray_tpu._private import rpc
@@ -340,6 +401,43 @@ class Dashboard:
                 per[row["state"]] = per.get(row["state"], 0) + 1
             return summary
 
+        def history_sample():
+            """One ring-buffer sample: per-node utilization + task-state
+            counts (blocking; runs on an executor thread)."""
+            import time as _time
+
+            ns = nodes()
+            ms = node_metrics()
+            per_node = {}
+            for n in ns:
+                if not n["alive"]:
+                    continue
+                m = ms.get(n["node_id"], {})
+                cpu_t = n["total"].get("CPU", 0.0)
+                cpu_a = n["available"].get("CPU", cpu_t)
+                per_node[n["node_id"]] = {
+                    "cpu_frac": ((cpu_t - cpu_a) / cpu_t) if cpu_t else None,
+                    "mem_frac": m.get("mem_frac"),
+                    "store_frac": m.get("store_frac"),
+                }
+            states: Dict[str, int] = {}
+            for row in _folded_tasks():
+                states[row["state"]] = states.get(row["state"], 0) + 1
+            return {"ts": _time.time(), "nodes": per_node, "tasks": states}
+
+        async def history_loop():
+            while True:
+                try:
+                    self._history.append(
+                        await loop.run_in_executor(None, history_sample))
+                except Exception:
+                    pass  # an unreachable GCS must not kill the series
+                await asyncio.sleep(self.history_interval_s)
+
+        def history():
+            return {"interval_s": self.history_interval_s,
+                    "samples": list(self._history)}
+
         def _node_addr(node_id_hex: str):
             for n in raw_nodes():
                 if n["node_id"].hex() == node_id_hex and n["alive"]:
@@ -370,12 +468,14 @@ class Dashboard:
         app.router.add_get("/api/cluster_status", offload(cluster_status))
         app.router.add_get("/api/tasks", offload(tasks))
         app.router.add_get("/api/task_summary", offload(task_summary))
+        app.router.add_get("/api/history", offload(history))
         app.router.add_get("/api/logs", offload(logs))
         app.router.add_get("/api/log", offload(log_tail))
         runner = web.AppRunner(app, access_log=None)
         await runner.setup()
         site = web.TCPSite(runner, host, port)
         await site.start()
+        self._history_task = loop.create_task(history_loop())
         for sock in site._server.sockets:  # type: ignore[union-attr]
             return sock.getsockname()[1]
         return port

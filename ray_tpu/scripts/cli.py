@@ -14,6 +14,8 @@ Usage:
     python -m ray_tpu job submit [--address A] -- CMD...
     python -m ray_tpu job list/status/logs/stop [ID]
     python -m ray_tpu timeline [--output PATH]
+    python -m ray_tpu profile [--name TASK]
+    python -m ray_tpu summary tasks
 """
 
 from __future__ import annotations
@@ -159,6 +161,66 @@ def _cmd_timeline(args) -> int:
     path = args.output or "ray-tpu-timeline.json"
     events = state.timeline(path)
     print(f"chrome://tracing timeline ({len(events)} events) written to {path}")
+    return 0
+
+
+def _fmt_phase_table(summary: dict) -> str:
+    """Render {phase: {count,p50,p95,p99,mean,total}} as an aligned table
+    (milliseconds — phase times are sub-second in the healthy case)."""
+    lines = [f"{'phase':18} {'count':>7} {'p50 ms':>9} {'p95 ms':>9} "
+             f"{'p99 ms':>9} {'mean ms':>9}"]
+    total_mean = 0.0
+    for phase, st in summary.items():
+        lines.append(
+            f"{phase:18} {st['count']:>7} {st['p50']*1e3:>9.3f} "
+            f"{st['p95']*1e3:>9.3f} {st['p99']*1e3:>9.3f} "
+            f"{st['mean']*1e3:>9.3f}")
+        if phase in ("driver_serialize", "driver_stage", "dispatch",
+                     "exec", "result_put", "result_wake"):
+            total_mean += st["mean"]
+    lines.append(f"{'sum(mean) end-to-end':18} {'':>7} {'':>9} {'':>9} "
+                 f"{'':>9} {total_mean*1e3:>9.3f}")
+    return "\n".join(lines)
+
+
+def _cmd_profile(args) -> int:
+    """Per-phase latency percentiles of completed tasks (the evidence layer
+    for 'where does a round-trip spend its time')."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    address = _resolve_address(args.address)
+    ray_tpu.init(address=address, ignore_reinit_error=True)
+    summary = state.summarize_task_phases(name=args.name)
+    if not summary:
+        print("no phased task completions recorded yet")
+        return 0
+    title = f"task phases ({args.name})" if args.name else "task phases"
+    print(title)
+    print(_fmt_phase_table(summary))
+    return 0
+
+
+def _cmd_summary(args) -> int:
+    """`ray_tpu summary tasks`: state counts per task name plus the phase
+    breakdown (reference: `ray summary tasks`)."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    if args.what != "tasks":
+        raise SystemExit(f"unknown summary target {args.what!r} "
+                         "(only 'tasks' is supported)")
+    address = _resolve_address(args.address)
+    ray_tpu.init(address=address, ignore_reinit_error=True)
+    summary = state.summarize_tasks()
+    print(f"{'task':28} states")
+    for name, states in sorted(summary.items()):
+        shown = " ".join(f"{s}={c}" for s, c in sorted(states.items()))
+        print(f"{name:28} {shown}")
+    phases = state.summarize_task_phases()
+    if phases:
+        print()
+        print(_fmt_phase_table(phases))
     return 0
 
 
@@ -321,6 +383,21 @@ def main(argv=None) -> int:
     p.add_argument("--address", default=None)
     p.add_argument("--output", default=None)
     p.set_defaults(fn=_cmd_timeline)
+
+    p = sub.add_parser("profile",
+                       help="per-phase task latency percentiles "
+                            "(p50/p95/p99 of the submit->wake hot path)")
+    p.add_argument("--address", default=None)
+    p.add_argument("--name", default=None,
+                   help="restrict to one task name")
+    p.set_defaults(fn=_cmd_profile)
+
+    p = sub.add_parser("summary",
+                       help="summarize cluster entities (currently: tasks)")
+    p.add_argument("what", choices=["tasks"],
+                   help="entity kind to summarize")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=_cmd_summary)
 
     p = sub.add_parser("memory",
                        help="per-node object-store usage + spill counters")

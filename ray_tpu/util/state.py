@@ -88,6 +88,46 @@ def summarize_tasks() -> Dict[str, Dict[str, int]]:
     return summary
 
 
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(round(q / 100.0 * (len(sorted_vals) - 1))),
+              len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def summarize_task_phases(name: Optional[str] = None,
+                          limit: int = 100_000) -> Dict[str, Dict[str, Any]]:
+    """Per-phase latency distribution of completed tasks, computed from the
+    PHASES annotations the driver emits when each completion lands (see
+    CoreWorker._observe_phases): {phase: {count, p50, p95, p99, mean,
+    total}}.  Phases are keyed in hot-path order (taskfold.PHASE_ORDER);
+    ``name`` filters to one task name."""
+    from ray_tpu._private.taskfold import PHASE_ORDER
+
+    per: Dict[str, List[float]] = {}
+    for row in list_tasks(limit=limit, name=name):
+        for k, v in (row.get("phases") or {}).items():
+            per.setdefault(k, []).append(v)
+    out: Dict[str, Dict[str, Any]] = {}
+    order = list(PHASE_ORDER) + sorted(set(per) - set(PHASE_ORDER))
+    for k in order:
+        vals = per.get(k)
+        if not vals:
+            continue
+        vals.sort()
+        out[k] = {
+            "count": len(vals),
+            "p50": _percentile(vals, 50),
+            "p95": _percentile(vals, 95),
+            "p99": _percentile(vals, 99),
+            "mean": sum(vals) / len(vals),
+            "total": sum(vals),
+        }
+    return out
+
+
 def _nodelet_call(node_id: Optional[str], method: str, msg=None):
     """RPC straight to one node's nodelet (address from the GCS node table).
     ``node_id=None`` targets the first alive node."""
@@ -149,10 +189,41 @@ def get_log(filename: str, node_id: Optional[str] = None,
     return blob.decode(errors="replace")
 
 
+def _phase_intervals(row: Dict[str, Any]) -> List[tuple]:
+    """Reconstruct absolute (phase, start, dur) intervals by chaining the
+    recorded phase durations backward from the completion timestamp (the
+    one absolute stamp every phased row has)."""
+    from ray_tpu._private.taskfold import PHASE_ORDER
+
+    phases = row.get("phases") or {}
+    chain = [(p, phases[p]) for p in PHASE_ORDER if p in phases]
+    if not chain:
+        return []
+    ts = row.get("state_ts", {})
+    # SUBMITTED is stamped right after serialization, i.e. between the
+    # driver_serialize and driver_stage phases; fall back to chaining
+    # backward from the terminal timestamp when lifecycle events were capped
+    submitted = ts.get("SUBMITTED")
+    if submitted is not None:
+        t = submitted - (chain[0][1] if chain[0][0] == "driver_serialize"
+                         else 0.0)
+    else:
+        end = ts.get("FINISHED") or ts.get("FAILED")
+        if end is None:
+            return []
+        t = end - sum(d for _, d in chain)
+    out = []
+    for p, d in chain:
+        out.append((p, t, d))
+        t += d
+    return out
+
+
 def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     """Chrome-tracing events (load via chrome://tracing or Perfetto) from the
-    task stream (reference: `ray timeline`).  Returns the event list; also
-    writes JSON to ``filename`` when given."""
+    task stream (reference: `ray timeline`).  Each completed task with a
+    phase breakdown also gets per-phase sub-slices on a parallel track.
+    Returns the event list; also writes JSON to ``filename`` when given."""
     trace = []
     for row in list_tasks(limit=100_000):
         ts = row["state_ts"]
@@ -175,6 +246,19 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
                 "type": row["type"],
             },
         })
+        for phase, p_start, p_dur in _phase_intervals(row):
+            trace.append({
+                "ph": "X",
+                "cat": "task_phase",
+                "name": f"{row['name']}:{phase}",
+                "pid": (row.get("node_id") or "?")[:8],
+                # parallel track so sub-ms phases stay visible next to the
+                # exec slice instead of nesting under it
+                "tid": f"{(row.get('worker_id') or '?')[:8]}-phases",
+                "ts": p_start * 1e6,
+                "dur": max(p_dur * 1e6, 0.5),
+                "args": {"task_id": row["task_id"], "phase": phase},
+            })
     if filename:
         with open(filename, "w") as f:
             json.dump(trace, f)

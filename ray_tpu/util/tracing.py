@@ -178,6 +178,18 @@ def export_otlp(filename: str, trace_id: Optional[str] = None,
         }
         if row.get("parent_span_id"):
             span["parentSpanId"] = row["parent_span_id"]
+        # Phase breakdown as OTLP span events: one event per hot-path phase
+        # at the phase's reconstructed start, duration as an attribute —
+        # Jaeger/Tempo render them as span logs on the task's timeline.
+        events = []
+        for phase, p_start, p_dur in state._phase_intervals(row):
+            events.append({
+                "timeUnixNano": str(int(p_start * 1e9)),
+                "name": f"phase.{phase}",
+                "attributes": [_otlp_attr("duration_s", p_dur)],
+            })
+        if events:
+            span["events"] = events
         spans.append(span)
     doc = {
         "resourceSpans": [{

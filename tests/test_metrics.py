@@ -53,8 +53,15 @@ def test_node_scrape_endpoint_and_worker_push():
     # find the node's scrape endpoint from the cluster status
     core = ray_tpu._private.worker.require_core()
     status = core.io.run(core.gcs_conn.call("get_cluster_status", None))
-    # metrics addr travels via register_node; ask the nodelet directly
-    text = core.io.run(core.nodelet_conn.call("get_metrics_text", None))
+    # metrics addr travels via register_node; ask the nodelet directly.
+    # Poll: the builtin gauges register on the nodelet's first heartbeat
+    # tick, which a fast first task can beat.
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        text = core.io.run(core.nodelet_conn.call("get_metrics_text", None))
+        if "ray_tpu_node_resources_total" in text:
+            break
+        time.sleep(0.2)
     assert "ray_tpu_node_resources_total" in text
 
     # worker-pushed user metric shows up after a push interval
