@@ -11,6 +11,7 @@ Prometheus scrapes directly — no separate agent process.
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -235,6 +236,32 @@ class Registry:
 
 
 default_registry = Registry()
+
+# Registered names are exported as ray_tpu_<name>, so they must be bare
+# Prometheus identifiers WITHOUT the prefix (a pre-prefixed name would
+# export double-prefixed and every dashboard query would miss it).
+METRIC_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+
+
+def validate_registry(registry: Optional[Registry] = None) -> List[str]:
+    """Metrics-hygiene walk: return a list of violations (empty = clean).
+    Rules: valid bare Prometheus name, no ray_tpu_ double prefix, nonempty
+    help text.  Conflicting-type duplicates cannot coexist — register()
+    raises at construction — so they need no walk here."""
+    reg = registry or default_registry
+    with reg._lock:
+        metrics = list(reg._metrics.values())
+    problems = []
+    for m in metrics:
+        if not METRIC_NAME_RE.match(m.name):
+            problems.append(f"{m.name!r}: not a valid metric name")
+        if m.name.startswith("ray_tpu_"):
+            problems.append(
+                f"{m.name!r}: names are exported with the ray_tpu_ prefix; "
+                "registering a pre-prefixed name double-prefixes the export")
+        if not (m.description or "").strip():
+            problems.append(f"{m.name!r}: empty help text")
+    return problems
 
 
 async def serve_metrics_http(registry: Registry, host: str = "127.0.0.1",

@@ -313,15 +313,24 @@ class CompiledDAG:
             None, _ft.partial(self.execute, value, timeout))
 
     def _ensure_out_channels(self):
-        """Each final edge's driver endpoint: eager for shm; for a tcp edge
-        the producer actor registers the rendezvous when its loop starts, so
-        the driver connects lazily here (first execute/result fetch)."""
+        """Each final edge's driver endpoint: eager for shm; a tcp edge is
+        constructed here on first use AND dialed immediately on a background
+        thread.  The dial must not wait for the first get(): the producer's
+        first write blocks in accept() with a bounded timeout, so a driver
+        that executes and then delays its first result fetch past that
+        timeout would otherwise kill the edge from the producer's side.
+        (Background thread because the producer registers the rendezvous
+        only when its loop starts — execute() must not block on that.)"""
+        import threading
+
         for i, ch in enumerate(self._out_channels):
             if ch is None:
                 ch = TcpChannel(self._final_descs[i][1], role="r",
                                 depth=self._depth)
                 self._channels.append(ch)
                 self._out_channels[i] = ch
+                threading.Thread(target=ch.dial, daemon=True,
+                                 name="dag-out-dial").start()
         return self._out_channels
 
     def _result_for(self, seq: int, timeout: Optional[float]) -> Any:

@@ -18,8 +18,16 @@ aiohttp app serving
     GET /api/task_summary   — {name: {state: count}}
     GET /api/history        — ring buffer of periodic scrapes (~15 min at
                               5 s): per-node cpu/mem/object-store fractions
-                              + task-state counts, rendered as sparklines
+                              + task-state counts + per-library series
+                              (serve/data/train), rendered as sparklines
                               on the page so past stalls stay visible
+    GET /api/serve          — per-deployment Serve view folded from the
+                              ray_tpu_serve_* series (reference:
+                              dashboard/modules/serve/)
+    GET /api/data           — per-operator Data pipeline view
+                              (ray_tpu_data_* series)
+    GET /api/train          — per-experiment Train view
+                              (ray_tpu_train_* series)
     GET /api/logs           — log files on a node   (?node_id=...)
     GET /api/log            — tail one log file     (?node_id=...&name=...)
 
@@ -121,9 +129,21 @@ async function loadLogs(nodeId) {
     box.appendChild(document.createTextNode(` (${f.size}b) `));
   }
 }
+function rate(vals, interval) {
+  // successive deltas of a cumulative counter -> per-second rate, clamped
+  // at 0 so a process restart (counter reset) doesn't plot negative
+  const out = [];
+  for (let i = 1; i < vals.length; i++) {
+    const a = vals[i - 1], b = vals[i];
+    out.push(a == null || b == null ? 0 :
+             Math.round(Math.max((b - a) / interval, 0) * 10) / 10);
+  }
+  return out;
+}
 async function load() {
   try {
-    const [nodes, metrics, actors, jobs, status, tasks, summary, history] =
+    const [nodes, metrics, actors, jobs, status, tasks, summary, history,
+           serveV, dataV, trainV] =
       await Promise.all([
         fetch('/api/nodes').then(r => r.json()),
         fetch('/api/node_metrics').then(r => r.json()),
@@ -133,6 +153,9 @@ async function load() {
         fetch('/api/tasks?limit=100').then(r => r.json()),
         fetch('/api/task_summary').then(r => r.json()),
         fetch('/api/history').then(r => r.json()),
+        fetch('/api/serve').then(r => r.json()),
+        fetch('/api/data').then(r => r.json()),
+        fetch('/api/train').then(r => r.json()),
       ]);
     let html = '<h2>Nodes</h2><table><tr><th>node</th><th>name</th>' +
       '<th>alive</th><th>CPU</th><th>mem</th><th>object store</th>' +
@@ -181,6 +204,65 @@ async function load() {
         }
         html += '</table>';
       }
+    }
+    const ivl = history.interval_s || 5;
+    const sdeps = Object.entries(serveV || {});
+    if (sdeps.length) {
+      html += '<h2>Serve</h2><table><tr><th>app/deployment</th>' +
+        '<th>replicas</th><th>requests</th><th>errors</th><th>queue</th>' +
+        '<th>p50 ms</th><th>p95 ms</th><th>req/s over time</th>' +
+        '<th>queue over time</th></tr>';
+      for (const [name, d] of sdeps.sort()) {
+        const series = k => samples.map(s => ((s.serve || {})[name] || {})[k]);
+        html += `<tr><td>${esc(name)}</td>` +
+          `<td>${d.replicas}/${d.target_replicas}</td>` +
+          `<td>${d.requests}</td><td>${d.errors}</td>` +
+          `<td>${d.queue_depth}</td>` +
+          `<td>${(d.latency_p50_s * 1e3).toFixed(2)}</td>` +
+          `<td>${(d.latency_p95_s * 1e3).toFixed(2)}</td>` +
+          `<td>${spark(rate(series('requests'), ivl), null, '#06c')}</td>` +
+          `<td>${spark(series('queue'), null, '#b8860b')}</td></tr>`;
+      }
+      html += '</table>';
+    }
+    const dops = Object.entries((dataV || {}).operators || {});
+    if (dops.length) {
+      html += '<h2>Data</h2><table><tr><th>dataset/operator</th>' +
+        '<th>rows</th><th>blocks</th><th>tasks</th><th>queue</th>' +
+        '<th>rows/s over time</th><th>queue over time</th></tr>';
+      for (const [name, d] of dops.sort()) {
+        const series = k => samples.map(s => ((s.data || {})[name] || {})[k]);
+        html += `<tr><td>${esc(name)}</td><td>${d.rows}</td>` +
+          `<td>${d.blocks}</td><td>${d.tasks}</td>` +
+          `<td>${d.output_queue_blocks}</td>` +
+          `<td>${spark(rate(series('rows'), ivl), null, '#070')}</td>` +
+          `<td>${spark(series('queue'), null, '#b8860b')}</td></tr>`;
+      }
+      html += '</table>';
+      for (const [ds, p] of Object.entries((dataV || {}).pipelines || {}))
+        html += `<p>pipeline ${esc(ds)}: buffered ` +
+          `${(p.buffered_bytes / 1048576).toFixed(1)} MiB ` +
+          (p.backpressure ? '<b style="color:#b00">BACKPRESSURED</b>'
+                          : '<span class="alive">flowing</span>') + '</p>';
+    }
+    const texps = Object.entries(trainV || {});
+    if (texps.length) {
+      html += '<h2>Train</h2><table><tr><th>experiment</th><th>state</th>' +
+        '<th>workers</th><th>reports</th><th>rounds</th><th>ckpts</th>' +
+        '<th>ckpt p50 s</th><th>reports/s over time</th></tr>';
+      for (const [name, d] of texps.sort()) {
+        const series = k => samples.map(s => ((s.train || {})[name] || {})[k]);
+        const cls = d.gang_state === 'FAILED' ? 'dead'
+                  : d.gang_state === 'RUNNING' ? 'state-RUNNING' : 'alive';
+        html += `<tr><td>${esc(name)}</td>` +
+          `<td class="${cls}">${esc(d.gang_state)}</td>` +
+          `<td>${d.workers}</td><td>${d.reports}</td>` +
+          `<td>${d.report_rounds}</td><td>${d.checkpoints}</td>` +
+          `<td>${d.checkpoint_p50_s.toFixed(3)}</td>` +
+          `<td>${spark(rate(series('reports'), ivl), null, '#7a4ad4')}` +
+          `</td></tr>`;
+      }
+      html += '</table>';
     }
     html += `<h2>Pending demand</h2><p>${esc(JSON.stringify(status.pending_demand))}</p>`;
     html += '<h2>Task summary</h2><table><tr><th>task</th><th>states</th></tr>';
@@ -302,12 +384,13 @@ class Dashboard:
                 out.append(n)
             return out
 
-        def node_metrics():
-            """Per-node utilization from each nodelet's metric registry.
-            Returns {node_id_hex: {mem_frac, store_frac, raw gauges...}}.
+        def scrape_texts() -> Dict[str, str]:
+            """Every alive nodelet's raw metrics text, keyed by node id.
             Scrapes fan out CONCURRENTLY with a tight per-node timeout — a
             64-host pod must not serialize 64 round-trips per page refresh,
-            and one unreachable nodelet must not stall the endpoint."""
+            and one unreachable nodelet must not stall the endpoint.  One
+            scrape feeds the utilization view, the library views AND the
+            history sample."""
             from ray_tpu._private import rpc as _rpc
 
             alive = [n for n in raw_nodes() if n["alive"]]
@@ -328,13 +411,14 @@ class Dashboard:
             async def scrape_all():
                 return await asyncio.gather(*(scrape(n) for n in alive))
 
-            out: Dict[str, dict] = {}
             with self._conn_lock:
                 io = self._io
-            for n, text in io.run(scrape_all()):
-                if text is None:
-                    continue
-                hexid = n["node_id"].hex()
+            return {n["node_id"].hex(): text
+                    for n, text in io.run(scrape_all()) if text is not None}
+
+        def _node_metrics_from(texts: Dict[str, str]) -> Dict[str, dict]:
+            out: Dict[str, dict] = {}
+            for hexid, text in texts.items():
                 gauges = _parse_prometheus(text)
 
                 def g(name):  # registry exports with the ray_tpu_ prefix
@@ -352,6 +436,32 @@ class Dashboard:
                     "gauges": gauges,
                 }
             return out
+
+        def node_metrics():
+            """Per-node utilization from each nodelet's metric registry:
+            {node_id_hex: {mem_frac, store_frac, raw gauges...}} (reference:
+            dashboard/modules/reporter/reporter_agent.py)."""
+            return _node_metrics_from(scrape_texts())
+
+        def _lib_samples():
+            from ray_tpu._private import metrics_view as mv
+
+            return mv.collect_samples(scrape_texts().values())
+
+        def serve_view():
+            from ray_tpu._private import metrics_view as mv
+
+            return mv.summarize_serve(_lib_samples())
+
+        def data_view():
+            from ray_tpu._private import metrics_view as mv
+
+            return mv.summarize_data(_lib_samples())
+
+        def train_view():
+            from ray_tpu._private import metrics_view as mv
+
+            return mv.summarize_train(_lib_samples())
 
         def actors():
             out = []
@@ -403,11 +513,15 @@ class Dashboard:
 
         def history_sample():
             """One ring-buffer sample: per-node utilization + task-state
-            counts (blocking; runs on an executor thread)."""
+            counts + compact library series (blocking; runs on an executor
+            thread).  One scrape round-trip feeds all of it."""
             import time as _time
 
+            from ray_tpu._private import metrics_view as mv
+
             ns = nodes()
-            ms = node_metrics()
+            texts = scrape_texts()
+            ms = _node_metrics_from(texts)
             per_node = {}
             for n in ns:
                 if not n["alive"]:
@@ -423,7 +537,10 @@ class Dashboard:
             states: Dict[str, int] = {}
             for row in _folded_tasks():
                 states[row["state"]] = states.get(row["state"], 0) + 1
-            return {"ts": _time.time(), "nodes": per_node, "tasks": states}
+            sample = {"ts": _time.time(), "nodes": per_node, "tasks": states}
+            sample.update(
+                mv.history_point(mv.collect_samples(texts.values())))
+            return sample
 
         async def history_loop():
             while True:
@@ -469,6 +586,9 @@ class Dashboard:
         app.router.add_get("/api/tasks", offload(tasks))
         app.router.add_get("/api/task_summary", offload(task_summary))
         app.router.add_get("/api/history", offload(history))
+        app.router.add_get("/api/serve", offload(serve_view))
+        app.router.add_get("/api/data", offload(data_view))
+        app.router.add_get("/api/train", offload(train_view))
         app.router.add_get("/api/logs", offload(logs))
         app.router.add_get("/api/log", offload(log_tail))
         runner = web.AppRunner(app, access_log=None)

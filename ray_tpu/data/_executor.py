@@ -139,10 +139,42 @@ class _OpState:
 
 class StreamingExecutor:
     def __init__(self, root: L.LogicalOp):
+        import time as _time
+        import uuid
+
+        from ray_tpu.data._metrics import data_metrics
+
         self.root = L.optimize(root)
         self.chain = L.plan_to_list(self.root)
         self.states = [_OpState(op, op.name()) for op in self.chain]
         self._stats: Dict[str, Dict[str, Any]] = {}
+        # library metrics: short per-executor uid keeps two concurrent
+        # pipelines' series distinct; operator label carries the plan index
+        # so views render the chain in order
+        self._id = uuid.uuid4().hex[:8]
+        self._metrics = data_metrics()
+        self._pipeline_labels = {"dataset": self._id}
+        for i, st in enumerate(self.states):
+            st.metric_labels = {"dataset": self._id,
+                                "operator": f"{i}:{st.name}"}
+        self._gated = False          # byte budget currently throttling reads
+        self._last_buffered = 0
+        self._gauge_clock = _time.monotonic
+        self._last_gauge_ts = 0.0
+
+    def _update_gauges(self, force: bool = False) -> None:
+        """Refresh queue/backpressure gauges, throttled: the scheduler loop
+        spins per block, but scrapes land every few seconds."""
+        now = self._gauge_clock()
+        if not force and now - self._last_gauge_ts < 0.2:
+            return
+        self._last_gauge_ts = now
+        m = self._metrics
+        for st in self.states:
+            m["queue"].set(len(st.output), st.metric_labels)
+        m["buffered_bytes"].set(self._last_buffered, self._pipeline_labels)
+        m["backpressure"].set(1.0 if self._gated else 0.0,
+                              self._pipeline_labels)
 
     # ------------------------------------------------------------ public
     def execute(self) -> Iterator[RefBundle]:
@@ -170,6 +202,7 @@ class StreamingExecutor:
                 progressed |= self._schedule_op(i)
             self._drain_completed()
             self._propagate(states)
+            self._update_gauges()
             while final.output:
                 ref, meta = final.output.popleft()
                 final.rows_emitted += meta.num_rows
@@ -179,6 +212,8 @@ class StreamingExecutor:
                 break
             if not progressed:
                 self._wait_any()
+        self._gated = False
+        self._update_gauges(force=True)
         for st in states:
             self._stats[st.name] = {
                 "tasks": st.tasks_launched,
@@ -245,6 +280,9 @@ class StreamingExecutor:
             if base_bytes >= ctx.max_buffered_bytes and st.input and \
                     not any(s.inflight for s in self.states):
                 forced = True
+            self._last_buffered = base_bytes
+            self._gated = bool(st.input) and \
+                base_bytes >= ctx.max_buffered_bytes and not forced
             while (st.input and downstream_room
                    and len(st.inflight) < ctx.max_tasks_in_flight_per_op
                    and (forced or base_bytes + admitted * st.avg_block_bytes
@@ -297,6 +335,10 @@ class StreamingExecutor:
                 st.input.clear()
                 for out in self._run_all_to_all(op, bundles):
                     st.output.append(out)
+                    self._metrics["blocks"].inc(1, st.metric_labels)
+                    if out[1].num_rows > 0:
+                        self._metrics["rows"].inc(out[1].num_rows,
+                                                  st.metric_labels)
                 st.done = True
                 progressed = True
         elif isinstance(op, L.Write):
@@ -362,6 +404,7 @@ class StreamingExecutor:
         st.emit_fifo.append(seq)
         st.inflight[bref] = (seq, mref, actor)
         st.tasks_launched += 1
+        self._metrics["tasks"].inc(1, st.metric_labels)
 
     def _drain_completed(self):
         pending = []
@@ -383,6 +426,9 @@ class StreamingExecutor:
                 st._blocks_seen += 1
                 st.avg_block_bytes += (meta.size_bytes - st.avg_block_bytes) \
                     / st._blocks_seen
+            self._metrics["blocks"].inc(1, st.metric_labels)
+            if meta.num_rows > 0:
+                self._metrics["rows"].inc(meta.num_rows, st.metric_labels)
             st.done_results[seq] = (bref, meta)
             while st.emit_fifo and st.emit_fifo[0] in st.done_results:
                 st.output.append(st.done_results.pop(st.emit_fifo.popleft()))

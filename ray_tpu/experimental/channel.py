@@ -336,12 +336,18 @@ class TcpChannel:
     ``depth`` credits, each message costs one, and the reader returns one
     1-byte ack per message consumed — so a slow consumer stalls the producer
     after ``depth`` in-flight messages exactly like the shm ring does.
+
+    The default connect/accept budget is 60 s, overridable with
+    ``RAY_TPU_CHAN_CONNECT_TIMEOUT_S`` (tests shorten it to exercise the
+    timeout paths without minute-long waits).
     """
 
     def __init__(self, name: str, *, role: str, depth: int = 2,
                  advertise_host: Optional[str] = None,
-                 connect_timeout: float = 60.0):
+                 connect_timeout: Optional[float] = None):
+        import os
         import socket
+        import threading
 
         assert role in ("r", "w")
         self.name = name
@@ -351,7 +357,15 @@ class TcpChannel:
         self._sock: Optional[socket.socket] = None
         self._listener: Optional[socket.socket] = None
         self._credits = depth
+        if connect_timeout is None:
+            connect_timeout = float(
+                os.environ.get("RAY_TPU_CHAN_CONNECT_TIMEOUT_S", 60.0))
         self._connect_timeout = connect_timeout
+        # dial/accept may run on a background thread (the compiled DAG's
+        # driver dials its output edges at execute time) while a reader
+        # thread enters read(): establishing the connection must be
+        # single-flight
+        self._conn_lock = threading.Lock()
         self._registered = False
         self._closed = False
         if role == "w":
@@ -359,21 +373,41 @@ class TcpChannel:
                 advertise_host = _node_advertise_host()
             ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            host = advertise_host
-            ls.bind((host if host != "0.0.0.0" else "", 0))
+            # Bind ALL interfaces: the advertised host may be a NAT'd /
+            # port-mapped address that is not a local interface, and binding
+            # it would either fail (EADDRNOTAVAIL) or hide the listener from
+            # the route the peer actually uses.  Reachability travels via
+            # the KV rendezvous value instead.
+            ls.bind(("", 0))
             ls.listen(1)
             self._listener = ls
             port = ls.getsockname()[1]
+            adv = advertise_host if advertise_host not in ("", "0.0.0.0") \
+                else "127.0.0.1"
             _kv_call("kv_put", {"ns": _KV_NS, "key": name,
-                                "value": pickle.dumps((host, port))})
+                                "value": pickle.dumps((adv, port))})
             self._registered = True
 
     # ---------------------------------------------------------- connection
-    def _ensure_conn(self, timeout: Optional[float]) -> None:
-        import socket
+    def dial(self) -> None:
+        """Establish the connection eagerly (best effort, swallows errors):
+        the compiled DAG calls this from a background thread at execute time
+        so the producer's accept() never waits on a tardy first get()."""
+        try:
+            self._ensure_conn(None)
+        except Exception:
+            pass  # the next read/write retries with a proper error path
 
+    def _ensure_conn(self, timeout: Optional[float]) -> None:
         if self._sock is not None:
             return
+        with self._conn_lock:
+            if self._sock is None:
+                self._connect_locked(timeout)
+
+    def _connect_locked(self, timeout: Optional[float]) -> None:
+        import socket
+
         if self._closed:
             raise ChannelClosed(f"tcp channel {self.name} is closed")
         budget = self._connect_timeout if timeout is None else timeout

@@ -15,7 +15,7 @@ Usage:
     python -m ray_tpu job list/status/logs/stop [ID]
     python -m ray_tpu timeline [--output PATH]
     python -m ray_tpu profile [--name TASK]
-    python -m ray_tpu summary tasks
+    python -m ray_tpu summary tasks|serve|data|train
 """
 
 from __future__ import annotations
@@ -202,26 +202,83 @@ def _cmd_profile(args) -> int:
 
 
 def _cmd_summary(args) -> int:
-    """`ray_tpu summary tasks`: state counts per task name plus the phase
-    breakdown (reference: `ray summary tasks`)."""
+    """`ray_tpu summary tasks|serve|data|train`: per-entity metric views
+    (reference: `ray summary tasks` + the dashboard's Serve/Data/Train
+    pages)."""
     import ray_tpu
     from ray_tpu.util import state
 
-    if args.what != "tasks":
-        raise SystemExit(f"unknown summary target {args.what!r} "
-                         "(only 'tasks' is supported)")
     address = _resolve_address(args.address)
     ray_tpu.init(address=address, ignore_reinit_error=True)
-    summary = state.summarize_tasks()
-    print(f"{'task':28} states")
-    for name, states in sorted(summary.items()):
-        shown = " ".join(f"{s}={c}" for s, c in sorted(states.items()))
-        print(f"{name:28} {shown}")
-    phases = state.summarize_task_phases()
-    if phases:
-        print()
-        print(_fmt_phase_table(phases))
+    if args.what == "tasks":
+        summary = state.summarize_tasks()
+        print(f"{'task':28} states")
+        for name, states in sorted(summary.items()):
+            shown = " ".join(f"{s}={c}" for s, c in sorted(states.items()))
+            print(f"{name:28} {shown}")
+        phases = state.summarize_task_phases()
+        if phases:
+            print()
+            print(_fmt_phase_table(phases))
+    elif args.what == "serve":
+        _print_serve_summary(state.summarize_serve())
+    elif args.what == "data":
+        _print_data_summary(state.summarize_data())
+    elif args.what == "train":
+        _print_train_summary(state.summarize_train())
     return 0
+
+
+def _print_serve_summary(summary: dict) -> None:
+    deployments = summary["deployments"]
+    if not deployments:
+        print("no serve metrics recorded yet (is an application deployed?)")
+        return
+    print(f"{'app/deployment':32} {'repl':>9} {'requests':>9} {'errors':>7} "
+          f"{'queue':>6} {'p50 ms':>9} {'p95 ms':>9} {'mean ms':>9}")
+    for name, d in sorted(deployments.items()):
+        repl = f"{d['replicas']:g}/{d['target_replicas']:g}"
+        print(f"{name:32} {repl:>9} {d['requests']:>9g} {d['errors']:>7g} "
+              f"{d['queue_depth']:>6g} {d['latency_p50_s']*1e3:>9.3f} "
+              f"{d['latency_p95_s']*1e3:>9.3f} "
+              f"{d['latency_mean_s']*1e3:>9.3f}")
+    events = summary.get("autoscale_events") or []
+    if events:
+        print(f"\nautoscaler decisions (last {min(len(events), 10)}):")
+        for ev in events[-10:]:
+            when = time.strftime("%H:%M:%S", time.localtime(ev["ts"]))
+            print(f"  {when} {ev['app']}/{ev['deployment']}: "
+                  f"{ev['from']} -> {ev['to']} ({ev['direction']}, "
+                  f"ongoing={ev['ongoing']})")
+
+
+def _print_data_summary(summary: dict) -> None:
+    ops = summary["operators"]
+    if not ops:
+        print("no data-pipeline metrics recorded yet")
+        return
+    print(f"{'dataset/operator':44} {'rows':>10} {'blocks':>8} "
+          f"{'tasks':>7} {'queue':>6}")
+    for name, d in sorted(ops.items()):
+        print(f"{name:44} {d['rows']:>10g} {d['blocks']:>8g} "
+              f"{d['tasks']:>7g} {d['output_queue_blocks']:>6g}")
+    pipelines = summary.get("pipelines") or {}
+    for ds, p in sorted(pipelines.items()):
+        gated = "BACKPRESSURED" if p["backpressure"] else "flowing"
+        print(f"pipeline {ds}: buffered "
+              f"{p['buffered_bytes']/2**20:.1f} MiB, {gated}")
+
+
+def _print_train_summary(summary: dict) -> None:
+    if not summary:
+        print("no train metrics recorded yet")
+        return
+    print(f"{'experiment':40} {'state':>9} {'workers':>8} {'reports':>8} "
+          f"{'rounds':>7} {'ckpts':>6} {'ckpt p50 s':>11}")
+    for name, d in sorted(summary.items()):
+        print(f"{name:40} {d['gang_state']:>9} {d['workers']:>8g} "
+              f"{d['reports']:>8g} {d['report_rounds']:>7g} "
+              f"{d['checkpoints']:>6g} {d['checkpoint_p50_s']:>11.3f}")
 
 
 def _cmd_memory(args) -> int:
@@ -393,8 +450,9 @@ def main(argv=None) -> int:
     p.set_defaults(fn=_cmd_profile)
 
     p = sub.add_parser("summary",
-                       help="summarize cluster entities (currently: tasks)")
-    p.add_argument("what", choices=["tasks"],
+                       help="summarize cluster entities "
+                            "(tasks, serve, data, train)")
+    p.add_argument("what", choices=["tasks", "serve", "data", "train"],
                    help="entity kind to summarize")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=_cmd_summary)

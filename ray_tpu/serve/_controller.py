@@ -125,6 +125,15 @@ class ServeController:
         self._proxy_lock = threading.Lock()
         # (app, deployment) -> {replica_actor_id_hex: [model ids]}
         self._multiplex: Dict[tuple, Dict[str, list]] = {}
+        from collections import deque
+
+        from ray_tpu.serve._metrics import serve_metrics
+
+        self._metrics = serve_metrics()
+        # bounded autoscaler decision log, queryable via
+        # get_autoscaler_events (surfaced by state.summarize_serve / the
+        # `ray_tpu summary serve` CLI)
+        self._autoscale_events: deque = deque(maxlen=256)
         # Serializes whole reconcile passes: deploy/delete call _reconcile_once
         # from the controller executor thread while the daemon loop runs its
         # own — concurrent passes would double-provision the same deficit.
@@ -173,6 +182,9 @@ class ServeController:
             for d in app.values():
                 self._stop_replicas(d)
                 self._lp.publish(f"replicas::{name}/{d.name}", [])
+                labels = {"app": name, "deployment": d.name}
+                self._metrics["replicas"].set(0, labels)
+                self._metrics["target_replicas"].set(0, labels)
         self._lp.publish("routes", self.get_routes())
         return True
 
@@ -276,7 +288,7 @@ class ServeController:
                         for d in deps.values()]
             for app, d in work:
                 self._health_check(d)
-                self._autoscale(d)
+                self._autoscale(app, d)
                 with self._lock:
                     missing = d.target - len(d.replicas)
                     surplus = [d.replicas.pop() for _ in
@@ -311,6 +323,9 @@ class ServeController:
                 if mux_value is not None:
                     self._lp.publish(f"multiplex::{app}/{d.name}", mux_value)
                 self._lp.publish(f"replicas::{app}/{d.name}", live)
+                labels = {"app": app, "deployment": d.name}
+                self._metrics["replicas"].set(len(live), labels)
+                self._metrics["target_replicas"].set(d.target, labels)
 
     def _start_replica(self, app: str, d: _DeploymentState):
         opts = dict(d.config.ray_actor_options or {})
@@ -343,7 +358,7 @@ class ServeController:
             with self._lock:
                 d.replicas = [r for r in d.replicas if r not in dead]
 
-    def _autoscale(self, d: _DeploymentState):
+    def _autoscale(self, app: str, d: _DeploymentState):
         cfg = d.config.autoscaling_config
         if cfg is None or not d.replicas:
             return
@@ -370,8 +385,20 @@ class ServeController:
         if now - d.scale_signal_since >= delay:
             logger.info("autoscaling %s: %d -> %d (ongoing=%d)",
                         d.name, d.target, desired, total_ongoing)
+            direction = "up" if desired > d.target else "down"
+            self._metrics["autoscale_decisions"].inc(
+                1, {"app": app, "deployment": d.name,
+                    "direction": direction})
+            self._autoscale_events.append({
+                "ts": time.time(), "app": app, "deployment": d.name,
+                "from": d.target, "to": desired, "direction": direction,
+                "ongoing": total_ongoing})
             d.target = desired
             d.scale_signal_since = None
+
+    def get_autoscaler_events(self) -> List[dict]:
+        """The bounded log of committed scale decisions, oldest first."""
+        return list(self._autoscale_events)
 
     def _stop_one(self, replica):
         """Graceful stop: let in-flight requests finish, then kill (reference:

@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from typing import Dict, Optional
 
 import ray_tpu
@@ -40,6 +41,16 @@ class ProxyActor:
         # request (reference: proxy's LongPollClient on route_table)
         self._routes: Optional[Dict[str, str]] = None
         self._routes_listener = None
+        from ray_tpu.serve._metrics import serve_metrics
+
+        self._metrics = serve_metrics()
+
+    def _observe_ingress(self, protocol: str, status: str,
+                         start: float) -> None:
+        self._metrics["ingress_requests"].inc(
+            1, {"protocol": protocol, "status": status})
+        self._metrics["ingress_latency"].observe(
+            time.perf_counter() - start, {"protocol": protocol})
 
     async def ready(self) -> int:
         """Start the aiohttp server (and the gRPC server when configured);
@@ -104,15 +115,19 @@ class ProxyActor:
         parts = method.strip("/").split("/", 1)
         app_name = parts[0]
         loop = asyncio.get_event_loop()
+        start = time.perf_counter()
         try:
             out = await loop.run_in_executor(
                 None, self._call_app, app_name, request)
         except LookupError:
+            self._observe_ingress("grpc", "not_found", start)
             await context.abort(grpc.StatusCode.NOT_FOUND,
                                 f"no application {app_name!r}")
         except Exception as e:
+            self._observe_ingress("grpc", "error", start)
             await context.abort(grpc.StatusCode.INTERNAL,
                                 f"{type(e).__name__}: {e}")
+        self._observe_ingress("grpc", "ok", start)
         if isinstance(out, bytes):
             return out
         if isinstance(out, str):
@@ -136,13 +151,17 @@ class ProxyActor:
         else:
             body = None
         loop = asyncio.get_event_loop()
+        start = time.perf_counter()
         try:
             out = await loop.run_in_executor(
                 None, self._route_and_call, path, body)
         except LookupError:
+            self._observe_ingress("http", "404", start)
             return web.Response(status=404, text="no route")
         except Exception as e:
+            self._observe_ingress("http", "500", start)
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        self._observe_ingress("http", "200", start)
         if isinstance(out, (dict, list)):
             return web.json_response(out)
         if isinstance(out, bytes):

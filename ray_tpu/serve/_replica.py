@@ -32,6 +32,12 @@ class ServeReplica:
         self._ongoing = 0
         self._total = 0
         self._started_at = time.time()
+        # library metrics: per-deployment request counter/latency/queue
+        # depth, pushed to the nodelet by this worker's CoreWorker loop
+        from ray_tpu.serve._metrics import serve_metrics
+
+        self._metrics = serve_metrics()
+        self._metric_labels = {"app": app_name, "deployment": deployment_name}
         # multiplex: loader caches report loaded-model sets through this
         # hook; fire-and-forget to the controller, fanned to routers via
         # long-poll (reference: replica multiplexed_model_ids reporting)
@@ -82,6 +88,10 @@ class ServeReplica:
             await asyncio.sleep(0.005)
         self._ongoing += 1
         self._total += 1
+        m, labels = self._metrics, self._metric_labels
+        m["queue_depth"].set(self._ongoing, labels)
+        start = time.perf_counter()
+        failed = False
         token = self._mux._model_id_ctx.set(multiplexed_model_id)
         try:
             call = getattr(self._user, method, None)
@@ -99,9 +109,17 @@ class ServeReplica:
             if inspect.isawaitable(out):
                 out = await out
             return out
+        except BaseException:
+            failed = True
+            raise
         finally:
             self._mux._model_id_ctx.reset(token)
             self._ongoing -= 1
+            m["queue_depth"].set(self._ongoing, labels)
+            m["requests"].inc(1, labels)
+            if failed:
+                m["request_errors"].inc(1, labels)
+            m["latency"].observe(time.perf_counter() - start, labels)
 
     async def _resolve_refs(self, args, kwargs):
         """Resolve top-level ObjectRefs (chained DeploymentResponses) to

@@ -83,12 +83,17 @@ class Checkpoint:
         return target
 
     def _download(self, target: str) -> None:
-        from ray_tpu.train import storage
+        import time
 
+        from ray_tpu.train import storage
+        from ray_tpu.train._metrics import train_metrics
+
+        t0 = time.perf_counter()
         if storage.is_uri(self.path):
             storage.download_dir(self.path, target)
         else:
             self.filesystem.download_dir(self.path, target)
+        train_metrics()["ckpt_restore"].observe(time.perf_counter() - t0)
 
     def __repr__(self):
         return f"Checkpoint({self.path!r})"

@@ -115,9 +115,18 @@ class _TrainSession:
                checkpoint: Optional[Checkpoint] = None) -> None:
         """Called from the user loop.  Persists the checkpoint, enqueues the
         result, and blocks until the actor thread consumed it."""
+        import time as _time
+
+        from ray_tpu.train._metrics import train_metrics
+
+        m = train_metrics()
+        labels = {"experiment": self.context.experiment_name or ""}
+        m["reports"].inc(1, labels)
         persisted = None
         if checkpoint is not None:
+            t0 = _time.perf_counter()
             persisted = self._persist_checkpoint(checkpoint)
+            m["ckpt_persist"].observe(_time.perf_counter() - t0, labels)
         self._result_q.put(_TrainingResult(dict(metrics), persisted))
         self._consumed.acquire()  # lockstep with the driver (reference :403)
 

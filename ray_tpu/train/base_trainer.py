@@ -165,10 +165,15 @@ class DataParallelTrainer(BaseTrainer):
     def training_loop(self) -> Result:
         """Reference: data_parallel_trainer.py:362 _run_training — but the
         executor lives on the driver side of the trial."""
+        from ray_tpu.train._metrics import GANG_STATES, train_metrics
+
         trial_dir = self.trial_dir
         storage.makedirs(trial_dir)
         self._save_trainer_state()
 
+        metrics = train_metrics()
+        mlabels = {"experiment": self.run_config.name or ""}
+        metrics["gang_state"].set(GANG_STATES["STARTING"], mlabels)
         executor = BackendExecutor(self.backend_config, self.scaling_config)
         executor.start()
         metrics_history = []
@@ -197,11 +202,14 @@ class DataParallelTrainer(BaseTrainer):
                 checkpoint_seq_start=_next_checkpoint_seq(trial_dir),
                 dataset_shards=dataset_shards,
             )
+            metrics["gang_state"].set(GANG_STATES["RUNNING"], mlabels)
+            metrics["gang_workers"].set(n_workers, mlabels)
             while True:
                 results = executor.get_next_results(
                     timeout_s=self.run_config.worker_report_timeout_s)
                 if results is None:
                     break
+                metrics["report_rounds"].inc(1, mlabels)
                 rank0 = results[0]
                 last_metrics = rank0.metrics
                 metrics_history.append(rank0.metrics)
@@ -214,7 +222,12 @@ class DataParallelTrainer(BaseTrainer):
                     latest_ckpt = ckpts.pop()
                     self._write_progress(trial_dir, latest_ckpt, last_metrics)
                     self._apply_retention(trial_dir, latest_ckpt)
+            metrics["gang_state"].set(GANG_STATES["FINISHED"], mlabels)
+        except BaseException:
+            metrics["gang_state"].set(GANG_STATES["FAILED"], mlabels)
+            raise
         finally:
+            metrics["gang_workers"].set(0, mlabels)
             executor.shutdown()
 
         return Result(

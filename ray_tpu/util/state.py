@@ -128,6 +128,66 @@ def summarize_task_phases(name: Optional[str] = None,
     return out
 
 
+def _collect_metric_samples():
+    """Labeled metric samples for the whole cluster: every alive nodelet's
+    scrape PLUS this process's local registry.  A driver's own series reach
+    the nodelet only on the periodic push, so reading the local registry
+    makes just-recorded driver metrics (e.g. a Data pipeline that finished
+    milliseconds ago) visible immediately; the pushed copies are excluded
+    by source so nothing double counts."""
+    from ray_tpu._private import metrics_view as mv
+    from ray_tpu._private.metrics import default_registry
+
+    core = require_core()
+    my_source = f"{core.mode}-{core.worker_id.hex()[:12]}"
+    texts = []
+    for n in list_nodes():
+        if n["state"] != "ALIVE":
+            continue
+        try:
+            texts.append(_nodelet_call(n["node_id"], "get_metrics_text"))
+        except Exception:
+            continue
+    samples = mv.collect_samples(texts, exclude_sources=(my_source,))
+    samples.extend(mv.parse_prometheus(default_registry.prometheus_text()))
+    return samples
+
+
+def summarize_serve() -> Dict[str, Any]:
+    """Per-deployment Serve metrics view + the controller's bounded
+    autoscaler decision log (reference: `serve status` + the dashboard
+    Serve view fed by ray_serve_* series)."""
+    from ray_tpu._private import metrics_view as mv
+
+    out = {"deployments": mv.summarize_serve(_collect_metric_samples()),
+           "autoscale_events": []}
+    try:
+        import ray_tpu
+        from ray_tpu.serve._controller import get_controller
+
+        out["autoscale_events"] = ray_tpu.get(
+            get_controller().get_autoscaler_events.remote(), timeout=10)
+    except Exception:
+        pass  # serve not running: metrics-only view
+    return out
+
+
+def summarize_data() -> Dict[str, Any]:
+    """Per-operator Data pipeline view: rows/blocks/tasks, output-queue
+    depth, and the byte-budget backpressure state per pipeline."""
+    from ray_tpu._private import metrics_view as mv
+
+    return mv.summarize_data(_collect_metric_samples())
+
+
+def summarize_train() -> Dict[str, Any]:
+    """Per-experiment Train view: gang lifecycle, report() counters, and
+    checkpoint-persist latency stats."""
+    from ray_tpu._private import metrics_view as mv
+
+    return mv.summarize_train(_collect_metric_samples())
+
+
 def _nodelet_call(node_id: Optional[str], method: str, msg=None):
     """RPC straight to one node's nodelet (address from the GCS node table).
     ``node_id=None`` targets the first alive node."""

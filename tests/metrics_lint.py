@@ -1,0 +1,88 @@
+"""Metrics-hygiene lint helper: walk every metric ray_tpu registers.
+
+Shared rules live in `ray_tpu._private.metrics.validate_registry` (valid
+bare Prometheus name, no ray_tpu_ double prefix, nonempty help text; a
+conflicting-kind duplicate raises at registration).  Two passes apply them:
+
+1. SOURCE: regex-walk ``ray_tpu/**/*.py`` for literal
+   Counter/Gauge/Histogram constructions — catches registration sites that
+   only run inside other processes (nodelet gauges, replica metrics)
+   without spinning those processes up.  Also flags one name constructed
+   as two different kinds anywhere in the tree.
+2. RUNTIME: instantiate every library metric-definition module into a
+   process registry and validate what actually registered.
+
+Used by tests/test_metrics_hygiene.py; importable from other suites.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Tuple
+
+from ray_tpu._private import metrics as M
+
+RAY_TPU_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "ray_tpu")
+
+# A literal construction: Kind("name"[, "description fragment" ...]).
+# \s spans newlines so the idiomatic wrapped call sites match; only the
+# first description fragment of an implicitly-concatenated string is
+# captured, which is enough for the nonempty check.
+_CONSTRUCT_RE = re.compile(
+    r"\b(Counter|Gauge|Histogram)\(\s*[\"']([^\"']+)[\"']"
+    r"(?:\s*,\s*[\"']([^\"']*)[\"'])?",
+    re.S)
+
+
+def collect_source_metrics() -> List[Tuple[str, str, str, str]]:
+    """Every literal metric construction under ray_tpu/:
+    (relpath, kind, name, first description fragment)."""
+    out = []
+    for dirpath, _dirs, files in os.walk(RAY_TPU_ROOT):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            rel = os.path.relpath(path, RAY_TPU_ROOT)
+            for kind, name, desc in _CONSTRUCT_RE.findall(text):
+                out.append((rel, kind, name, desc or ""))
+    return out
+
+
+def lint_source() -> List[str]:
+    problems: List[str] = []
+    kinds: Dict[str, Tuple[str, str]] = {}  # name -> (kind, first site)
+    for rel, kind, name, desc in collect_source_metrics():
+        site = f"{rel}: {kind}({name!r})"
+        if not M.METRIC_NAME_RE.match(name):
+            problems.append(f"{site}: invalid metric name")
+        if name.startswith("ray_tpu_"):
+            problems.append(
+                f"{site}: pre-prefixed name (export adds ray_tpu_)")
+        if not desc.strip():
+            problems.append(f"{site}: missing/empty help text")
+        prev = kinds.get(name)
+        if prev is not None and prev[0] != kind:
+            problems.append(
+                f"{site}: conflicts with {prev[1]} ({prev[0]}) — one name, "
+                "two metric kinds")
+        else:
+            kinds.setdefault(name, (kind, site))
+    return problems
+
+
+def lint_runtime() -> List[str]:
+    """Instantiate every library metric set into the process registry and
+    validate everything registered there."""
+    from ray_tpu.data._metrics import data_metrics
+    from ray_tpu.serve._metrics import serve_metrics
+    from ray_tpu.train._metrics import train_metrics
+
+    serve_metrics()
+    data_metrics()
+    train_metrics()
+    return M.validate_registry(M.default_registry)

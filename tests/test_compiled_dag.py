@@ -221,6 +221,87 @@ def test_compiled_cross_node_pipeline():
         cluster.shutdown()
 
 
+def test_tcp_channel_writer_binds_all_interfaces(cluster):
+    """The writer's listener must bind every interface while the KV
+    rendezvous advertises the (possibly NAT'd/port-mapped) reachable host:
+    binding the advertised IP itself fails with EADDRNOTAVAIL when that IP
+    is not a local interface (ADVICE: TcpChannel under NAT)."""
+    import pickle
+    import socket
+
+    from ray_tpu._private.worker import require_core
+    from ray_tpu.experimental.channel import TcpChannel
+
+    # TEST-NET-3 address: guaranteed not to be a local interface, so the
+    # pre-fix bind(advertised_ip) would have raised here
+    w = TcpChannel("nat-bind-test", role="w", advertise_host="203.0.113.7",
+                   connect_timeout=10.0)
+    try:
+        blob = require_core().gcs_call_sync(
+            "kv_get", {"ns": "_dagchan", "key": "nat-bind-test"})
+        host, port = pickle.loads(blob)
+        assert host == "203.0.113.7"  # rendezvous carries the advertised host
+        # ...while the listener accepts on any interface (the NAT'd path):
+        s = socket.create_connection(("127.0.0.1", port), timeout=5)
+        try:
+            w._ensure_conn(5.0)
+            w.write_bytes(b"through-the-nat")
+            hdr = s.recv(8)
+            n = int.from_bytes(hdr, "little")
+            assert s.recv(n) == b"through-the-nat"
+        finally:
+            s.close()
+    finally:
+        w.close()
+
+
+def test_cross_node_output_edge_survives_delayed_get():
+    """Regression (ADVICE): the driver must DIAL its tcp output edge at
+    execute time.  Before the fix it only constructed the reader, so a
+    first get() delayed past the producer's accept timeout killed the edge
+    in the producer's accept() and every result after it.  Run with a
+    shortened accept budget so the pre-fix behavior would fail in seconds."""
+    import os
+
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    old = os.environ.get("RAY_TPU_CHAN_CONNECT_TIMEOUT_S")
+    os.environ["RAY_TPU_CHAN_CONNECT_TIMEOUT_S"] = "4"
+    cluster = Cluster()
+    try:
+        cluster.add_node(num_cpus=2, resources={"siteA": 2})
+        ray_tpu.init(address=cluster.address)
+        cluster.add_node(num_cpus=2, resources={"siteB": 2})
+        cluster.wait_for_nodes()
+
+        # the stage lives on the OTHER node: both the input edge and the
+        # output edge to the driver are tcp
+        a = _Stage.options(num_cpus=0.1, resources={"siteB": 1}).remote(1)
+        ray_tpu.get(a.add.remote(0), timeout=120)
+        with InputNode() as inp:
+            dag = a.add.bind(inp)
+        compiled = dag.experimental_compile()
+        try:
+            assert "tcp" in compiled._edge_kinds, compiled._edge_kinds
+            ref = compiled.execute(41)
+            # delay the first fetch PAST the 4 s accept budget: the eager
+            # background dial must have kept the producer's edge alive
+            time.sleep(6.0)
+            assert ref.get(timeout=30) == 42
+            # the edge stays healthy for later executes too
+            assert compiled.execute(1).get(timeout=30) == 2
+        finally:
+            compiled.teardown()
+    finally:
+        if old is None:
+            os.environ.pop("RAY_TPU_CHAN_CONNECT_TIMEOUT_S", None)
+        else:
+            os.environ["RAY_TPU_CHAN_CONNECT_TIMEOUT_S"] = old
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
 def test_compiled_multi_output_and_shared_actor(cluster):
     """MultiOutputNode roots return a list per execute, and one actor may
     host several compiled nodes (its loop runs them in topo order) —

@@ -17,7 +17,8 @@ def cluster():
     yield ensure_shared_runtime()
 
 
-def test_dashboard_endpoints(cluster):
+def _start_dashboard():
+    """Run a Dashboard on a daemon thread; returns (dash, port)."""
     import asyncio
 
     from ray_tpu.dashboard import Dashboard
@@ -45,7 +46,11 @@ def test_dashboard_endpoints(cluster):
     t = threading.Thread(target=run_loop, daemon=True)
     t.start()
     assert started.wait(30)
-    port = port_holder["port"]
+    return dash, port_holder["port"]
+
+
+def test_dashboard_endpoints(cluster):
+    dash, port = _start_dashboard()
 
     @ray_tpu.remote
     class Marker:
@@ -102,6 +107,48 @@ def test_dashboard_endpoints(cluster):
     with urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=30) as r:
         assert b"ray_tpu" in r.read()
     ray_tpu.kill(m)
+
+
+def test_history_endpoint_shapes(cluster):
+    """/api/history must serve well-formed series for an EMPTY ring buffer
+    (fresh dashboard) and a PARTIALLY-FILLED one (samples predating the
+    library series carry no serve/data/train keys)."""
+    import time as _t
+
+    dash, port = _start_dashboard()
+
+    def get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            return json.loads(r.read())
+
+    # empty ring: the loop may not have ticked yet — force emptiness
+    dash._history.clear()
+    out = get("/api/history")
+    assert isinstance(out["interval_s"], (int, float))
+    assert out["samples"] == []
+
+    # partially filled: an old-format sample (no library keys) next to a
+    # full one must both serialize and keep their fields
+    dash._history.clear()
+    dash._history.append({"ts": _t.time(), "nodes": {}, "tasks": {}})
+    dash._history.append({
+        "ts": _t.time(), "nodes": {"n1": {"cpu_frac": 0.5}},
+        "tasks": {"RUNNING": 2},
+        "serve": {"a/D": {"requests": 3, "queue": 1, "replicas": 1}},
+        "data": {}, "train": {},
+    })
+    out = get("/api/history")
+    assert len(out["samples"]) == 2
+    assert "serve" not in out["samples"][0]
+    assert out["samples"][1]["serve"]["a/D"]["requests"] == 3
+    assert out["samples"][1]["nodes"]["n1"]["cpu_frac"] == 0.5
+
+    # library view endpoints: well-formed shells on an idle cluster
+    assert isinstance(get("/api/serve"), dict)
+    data_view = get("/api/data")
+    assert set(data_view) == {"operators", "pipelines"}
+    assert isinstance(get("/api/train"), dict)
 
 
 def test_state_log_api(cluster):

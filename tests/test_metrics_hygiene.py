@@ -1,0 +1,56 @@
+"""Metrics hygiene: every metric ray_tpu registers must export cleanly —
+bare Prometheus name (the ray_tpu_ prefix is added at export), nonempty
+help text, and one kind per name (rules + walker in tests/metrics_lint.py
+and `_private.metrics.validate_registry`)."""
+
+import pytest
+
+from ray_tpu._private import metrics as M
+from metrics_lint import collect_source_metrics, lint_runtime, lint_source
+
+
+def test_source_walk_finds_the_known_definition_sites():
+    """The regex walker must actually see the library + nodelet metric
+    definitions, or the lint pass is vacuously green."""
+    names = {name for _rel, _kind, name, _d in collect_source_metrics()}
+    for expected in ("serve_request_latency_seconds", "data_rows_output_total",
+                     "train_report_total", "node_resources_total",
+                     "task_phase_seconds"):
+        assert expected in names, f"walker missed {expected}"
+
+
+def test_source_metric_definitions_are_hygienic():
+    assert lint_source() == []
+
+
+def test_runtime_registry_is_hygienic():
+    assert lint_runtime() == []
+
+
+def test_conflicting_kind_registration_raises():
+    reg = M.Registry()
+    M.Counter("dup_kind_metric", "a counter", registry=reg)
+    with pytest.raises(ValueError, match="already registered"):
+        M.Gauge("dup_kind_metric", "now a gauge", registry=reg)
+
+
+def test_same_kind_reregistration_adopts_storage():
+    reg = M.Registry()
+    a = M.Counter("rereg_metric", "c", registry=reg)
+    a.inc(2)
+    b = M.Counter("rereg_metric", "c", registry=reg)
+    b.inc(3)
+    assert dict(a.samples()) == {(): 5.0}
+
+
+def test_validate_registry_flags_violations():
+    reg = M.Registry()
+    M.Counter("ok_metric", "fine", registry=reg)
+    M.Counter("bad metric name", "desc", registry=reg)
+    M.Counter("ray_tpu_prefixed", "desc", registry=reg)
+    M.Gauge("no_help_text", "", registry=reg)
+    problems = "\n".join(M.validate_registry(reg))
+    assert "bad metric name" in problems
+    assert "ray_tpu_prefixed" in problems
+    assert "no_help_text" in problems
+    assert "ok_metric" not in problems
