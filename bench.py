@@ -279,6 +279,63 @@ def main() -> None:
         except Exception as e:
             result["micro_error"] = repr(e)
 
+    # Watchdog/sampler overhead guard (ISSUE 3): the hang watchdog polls
+    # every busy worker and the stack sampler rides the worker RPC loop —
+    # both must be free on the task hot path.  Measure the same noop
+    # round-trip rate with the watchdog at a hot 0.5 s interval and fully
+    # disabled; both numbers land in the bench record so a regression shows
+    # up as a ratio drift, not a silent slowdown.
+    if os.environ.get("RAY_TPU_BENCH_MICRO", "1") != "0":
+        import subprocess
+        import sys
+
+        rate_code = (
+            "import json, time, ray_tpu\n"
+            "from ray_tpu._private.ray_perf import host_cpu_count\n"
+            "ray_tpu.init(num_cpus=host_cpu_count(), "
+            "object_store_memory=1024**3)\n"
+            "@ray_tpu.remote\n"
+            "def noop():\n"
+            "    return None\n"
+            "ray_tpu.get(noop.remote())\n"
+            "t0 = time.perf_counter(); n = 0\n"
+            "while time.perf_counter() - t0 < 2.0:\n"
+            "    ray_tpu.get(noop.remote()); n += 1\n"
+            "print('RATE=' + json.dumps(round(n / "
+            "(time.perf_counter() - t0), 1)))\n")
+
+        def _noop_rate(extra_env):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env.update(extra_env)
+            proc = subprocess.Popen([sys.executable, "-c", rate_code],
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True,
+                                    env=env, start_new_session=True)
+            try:
+                stdout, _ = proc.communicate(timeout=90)
+            except subprocess.TimeoutExpired:
+                import signal
+
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait()
+                return None
+            for line in stdout.splitlines():
+                if line.startswith("RATE="):
+                    return json.loads(line[len("RATE="):])
+            return None
+
+        try:
+            on = _noop_rate({"RAY_TPU_HANG_WATCHDOG_INTERVAL_S": "0.5"})
+            off = _noop_rate({"RAY_TPU_HANG_WATCHDOG_INTERVAL_S": "0"})
+            result["watchdog_overhead"] = {
+                "tasks_sync_watchdog_on": on,
+                "tasks_sync_watchdog_off": off,
+                "ratio": round(on / off, 3) if on and off else None,
+            }
+        except Exception as e:
+            result["watchdog_overhead"] = {"error": repr(e)}
+
     if result.get("platform") == "tpu":
         result["source"] = "live"
         try:

@@ -138,12 +138,30 @@ _d("test_hooks", bool, False, "enable fault-injection RPCs (set_env); never on i
 _d("task_events_flush_interval_ms", int, 1_000, "task event flush period")
 _d("task_events_max_buffer_size", int, 10_000, "drop task events beyond this")
 
+# --- Hang diagnosis ---
+_d("hang_watchdog_interval_s", float, 2.0,
+   "nodelet hang-watchdog poll period; 0 disables the watchdog")
+_d("hang_threshold_s", float, 300.0,
+   "absolute fallback: a task running longer than this is flagged as "
+   "suspected hung (used when no per-name p95 history exists)")
+_d("hang_p95_multiplier", float, 10.0,
+   "flag a task as suspected hung past this multiple of its name's "
+   "recent exec p95")
+_d("hang_p95_floor_s", float, 5.0,
+   "never flag via the p95 path below this elapsed time (sub-second tasks "
+   "jitter well past 10x p95 without being hung)")
+_d("hang_min_samples", int, 5,
+   "completed same-name tasks required before the p95 path applies")
+
 # --- Logging ---
 _d("log_to_driver", bool, True, "forward worker stdout/stderr to the driver")
 
 # --- Collectives ---
 _d("collective_rendezvous_timeout_s", float, 60.0, "collective group formation timeout")
 _d("collective_op_timeout_s", float, 300.0, "single collective op timeout")
+_d("collective_default_timeout_s", float, 300.0,
+   "default timeout_s for recv/barrier (and the other collectives); on "
+   "expiry CollectiveTimeout names the group, op, and lagging rank(s)")
 
 # --- Runtime environments ---
 _d("runtime_env_pip_no_index", bool, False,

@@ -126,6 +126,14 @@ class CoreWorker:
         self._cancelled_exec_order: deque = deque()
         self._running_threads: Dict[bytes, int] = {}
         self._running_async: Dict[bytes, "asyncio.Task"] = {}
+        # Live-introspection state (`ray_tpu stack` / hang watchdog): every
+        # currently-executing task keyed by task id -> {name, attempt,
+        # start (monotonic), thread (ident, None for async)}, plus a small
+        # per-name reservoir of recent exec durations so the nodelet's
+        # watchdog can compare a running task against its own history.
+        self._running_tasks: Dict[bytes, dict] = {}
+        self._exec_hist: Dict[str, deque] = {}
+        self._exec_hist_lock = threading.Lock()
         # driver side: tasks the user cancelled (suppresses retry-on-death
         # when force-cancel kills the worker mid-task)
         self._cancelled_tasks: set = set()
@@ -1041,6 +1049,88 @@ class CoreWorker:
 
     async def rpc_ping(self, conn, msg):
         return {"worker_id": self.worker_id.binary(), "pid": os.getpid()}
+
+    # ----------------------------------------------- live introspection
+    def _track_task_start(self, spec: TaskSpec, thread_ident) -> None:
+        """Register an executing task for the stack sampler / hang watchdog
+        (dict assignment: safe from executor threads under the GIL)."""
+        self._running_tasks[spec.task_id.binary()] = {
+            "task_id": spec.task_id.hex(), "name": spec.name,
+            "attempt": spec.attempt_number, "start": time.monotonic(),
+            "thread": thread_ident,
+        }
+
+    def _track_task_end(self, spec: TaskSpec) -> None:
+        info = self._running_tasks.pop(spec.task_id.binary(), None)
+        if info is None:
+            return
+        dur = time.monotonic() - info["start"]
+        name = spec.name or "?"
+        with self._exec_hist_lock:
+            dq = self._exec_hist.get(name)
+            if dq is None:
+                if len(self._exec_hist) >= 512:
+                    # unbounded task-name churn (closures minted per call)
+                    # must not grow a long-lived worker without limit
+                    self._exec_hist.clear()
+                dq = self._exec_hist[name] = deque(maxlen=64)
+            dq.append(dur)
+
+    def _exec_p95(self, name: str) -> Tuple[Optional[float], int]:
+        """(p95, sample count) of this worker's recent exec durations for
+        one task name — the watchdog's per-name baseline."""
+        with self._exec_hist_lock:
+            dq = self._exec_hist.get(name)
+            vals = sorted(dq) if dq else None
+        if not vals:
+            return None, 0
+        idx = min(int(round(0.95 * (len(vals) - 1))), len(vals) - 1)
+        return vals[idx], len(vals)
+
+    async def rpc_get_running_tasks(self, conn, msg):
+        """Currently-executing tasks with elapsed time + this worker's
+        per-name exec p95 — the nodelet hang watchdog's poll target."""
+        now = time.monotonic()
+        out = []
+        for info in list(self._running_tasks.values()):
+            p95, count = self._exec_p95(info["name"] or "?")
+            out.append({
+                "task_id": info["task_id"], "name": info["name"],
+                "attempt": info["attempt"],
+                "elapsed_s": now - info["start"],
+                "p95_s": p95, "samples": count,
+            })
+        return out
+
+    async def rpc_dump_stacks(self, conn, msg):
+        """All Python thread stacks of this process plus the running-task
+        map (the `ray_tpu stack` payload; reference: `ray stack` via py-spy,
+        here in-process with zero external deps)."""
+        return self.capture_stacks()
+
+    def capture_stacks(self) -> dict:
+        from ray_tpu._private.introspect import capture_thread_stacks
+
+        now = time.monotonic()
+        by_thread: Dict[int, dict] = {}
+        running = []
+        for info in list(self._running_tasks.values()):
+            if info.get("thread") is not None:
+                by_thread[info["thread"]] = info
+            running.append({
+                "task_id": info["task_id"], "name": info["name"],
+                "attempt": info["attempt"],
+                "elapsed_s": now - info["start"],
+            })
+        return {
+            "kind": self.mode,
+            "pid": self._pid,
+            "worker_id": self._worker_id_hex,
+            "actor_id": self.actor_id.hex() if self.actor_id else None,
+            "node_id": self._node_id_hex,
+            "threads": capture_thread_stacks(by_thread),
+            "running_tasks": running,
+        }
 
     async def rpc_debug_state(self, conn, msg):
         """Introspection for the state API + stuck-worker diagnosis."""
@@ -2038,6 +2128,7 @@ class CoreWorker:
         self.task_ctx.job_id = spec.job_id
         self.task_ctx.task_name = spec.name
         self.task_ctx.attempt_number = spec.attempt_number
+        self._track_task_start(spec, threading.get_ident())
         trace_token = _trace_ctx.set((spec.trace_id, spec.span_id))
         if self.job_id.int_value() == 0:
             self.job_id = spec.job_id
@@ -2067,6 +2158,7 @@ class CoreWorker:
                     "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
         finally:
             self.task_ctx.task_id = None
+            self._track_task_end(spec)
             _trace_ctx.reset(trace_token)
 
     async def _invoke_async(self, spec: TaskSpec, method) -> dict:
@@ -2078,6 +2170,9 @@ class CoreWorker:
             return {"status": "error", "cancelled": True,
                     "error": pickle.dumps(TaskCancelledError(
                         f"task {spec.name} was cancelled before it started"))}
+        # thread=None: async tasks share the IO loop thread, so stack
+        # attribution is via the running-task list, not a thread id
+        self._track_task_start(spec, None)
         try:
             loop = asyncio.get_event_loop()
             t0 = time.time()
@@ -2117,6 +2212,7 @@ class CoreWorker:
             return {"status": "error",
                     "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
         finally:
+            self._track_task_end(spec)
             _trace_ctx.reset(trace_token)
 
     def _pack_returns(self, spec: TaskSpec, out) -> dict:

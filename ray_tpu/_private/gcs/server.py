@@ -1008,6 +1008,29 @@ class GcsServer:
         self.task_events.extend(msg["events"])
         return True
 
+    async def rpc_dump_stacks(self, conn, msg):
+        """Proxy a live stack dump to one node's nodelet — or fan out to
+        every alive node — over the nodes' existing registration
+        connections, so the state API / CLI / dashboard reach any process
+        through the GCS they already talk to (the `ray_tpu stack` path)."""
+        msg = msg or {}
+        node_hex = msg.get("node_id")
+        task_id = msg.get("task_id")
+        targets = [info for nid, info in self.nodes.items()
+                   if info.alive and (node_hex is None
+                                      or nid.hex().startswith(node_hex))]
+
+        async def one(info):
+            try:
+                return await info.conn.call(
+                    "dump_stacks", {"task_id": task_id}, timeout=20)
+            except (ConnectionError, rpc.ConnectionLost,
+                    asyncio.TimeoutError):
+                return None
+
+        dumps = await asyncio.gather(*(one(i) for i in targets))
+        return [d for d in dumps if d is not None]
+
     async def rpc_get_task_events(self, conn, msg):
         limit = msg.get("limit", 1000)
         job = msg.get("job_id")

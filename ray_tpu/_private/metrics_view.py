@@ -246,16 +246,29 @@ def summarize_train(samples: List[Sample]) -> Dict[str, Dict]:
     rounds = _sum_by(samples, "ray_tpu_train_report_rounds_total", keys)
     state = _max_by(samples, "ray_tpu_train_gang_state", keys)
     workers = _max_by(samples, "ray_tpu_train_gang_workers", keys)
+    skew = _max_by(samples, "ray_tpu_train_gang_step_skew", keys)
     ckpt = _hist_by(samples, "ray_tpu_train_checkpoint_persist_seconds", keys)
+    # per-rank step heartbeats: derive skew directly from the rank gauges
+    # too, so the view names stragglers even before (or without) the
+    # driver-folded skew gauge landing on a scrape
+    rank_steps = _max_by(samples, "ray_tpu_train_rank_step",
+                         ("experiment", "rank"))
+    steps_per_exp: Dict[_Key, List[float]] = {}
+    for (exp, _rank), v in rank_steps.items():
+        steps_per_exp.setdefault((exp,), []).append(v)
     out: Dict[str, Dict] = {}
     for k in set(reports) | set(rounds) | set(state) | set(workers) \
-            | set(ckpt):
+            | set(ckpt) | set(skew) | set(steps_per_exp):
         stats = ckpt.get(k, {})
+        steps = steps_per_exp.get(k, [])
+        derived_skew = (max(steps) - min(steps)) if len(steps) > 1 else 0.0
         out[k[0]] = {
             "gang_state": _GANG_NAMES.get(state.get(k, -1.0), "UNKNOWN"),
             "workers": workers.get(k, 0.0),
             "reports": reports.get(k, 0.0),
             "report_rounds": rounds.get(k, 0.0),
+            "step": max(steps) if steps else 0.0,
+            "step_skew": max(skew.get(k, 0.0), derived_skew),
             "checkpoints": stats.get("count", 0.0),
             "checkpoint_mean_s": stats.get("mean", 0.0),
             "checkpoint_p50_s": stats.get("p50", 0.0),

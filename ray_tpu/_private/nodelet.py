@@ -160,6 +160,9 @@ class Nodelet:
         self._shutting_down = False
         self._gcs_reconnecting = False
         self._disk_full = False
+        # hang watchdog: (task_id hex, attempt) -> flag record of tasks
+        # currently running past their threshold on this node
+        self._suspected_hung: Dict[Tuple[str, int], dict] = {}
 
     # ------------------------------------------------------------------ boot
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
@@ -180,6 +183,7 @@ class Nodelet:
         self._bg.append(asyncio.get_event_loop().create_task(self._monitor_workers_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._flush_dir_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._fs_monitor_loop()))
+        self._bg.append(asyncio.get_event_loop().create_task(self._hang_watchdog_loop()))
         logger.info("nodelet %s on %s:%s resources=%s",
                     self.node_id.hex()[:8], *self.addr, self.resources_total)
         return self.addr
@@ -517,6 +521,152 @@ class Nodelet:
                 return f.read()
         except FileNotFoundError:
             return None
+
+    # ------------------------------------------------- stacks / hang watchdog
+    def _live_worker_conns(self) -> List[WorkerHandle]:
+        return [w for w in self.workers.values()
+                if w.conn is not None and not w.conn.closed
+                and w.state not in ("starting", "dead")]
+
+    async def rpc_dump_stacks(self, conn, msg):
+        """Fan `dump_stacks` out to every registered worker on this node and
+        capture the nodelet's own threads (the `ray_tpu stack` node payload;
+        reference: `ray stack` shells out to py-spy per process — here each
+        process samples itself via sys._current_frames()).  ``task_id``
+        narrows the reply to workers currently executing that task."""
+        from ray_tpu._private.introspect import capture_thread_stacks
+
+        msg = msg or {}
+        task_id = msg.get("task_id")
+
+        async def one(w: WorkerHandle):
+            try:
+                return await w.conn.call("dump_stacks", None, timeout=10)
+            except (ConnectionError, rpc.ConnectionLost,
+                    asyncio.TimeoutError):
+                return None
+
+        dumps = await asyncio.gather(*(one(w)
+                                       for w in self._live_worker_conns()))
+        workers = [d for d in dumps if d is not None]
+        if task_id:
+            workers = [d for d in workers
+                       if any(t["task_id"].startswith(task_id)
+                              for t in d.get("running_tasks", []))]
+        out = {"node_id": self.node_id.hex(), "addr": list(self.addr),
+               "workers": workers}
+        if not task_id:
+            out["nodelet"] = {"kind": "nodelet", "pid": os.getpid(),
+                              "threads": capture_thread_stacks(),
+                              "running_tasks": []}
+        return out
+
+    @staticmethod
+    def _env_float(name: str, default: float) -> float:
+        """Live env override (read per tick, unlike RayConfig's first-read
+        cache) so tests and operators can retune the watchdog on a running
+        node via the set_env hook / environment."""
+        raw = os.environ.get(name)
+        if raw:
+            try:
+                return float(raw)
+            except ValueError:
+                pass
+        return default
+
+    async def _hang_watchdog_loop(self):
+        """Flag tasks running suspiciously long (reference: the dashboard's
+        hanging-task diagnosis from task events).  Each tick polls every
+        busy worker's running tasks; a task is suspected hung past
+        max(hang_p95_multiplier x its name's recent exec p95,
+        hang_p95_floor_s), or past the absolute RAY_TPU_HANG_THRESHOLD_S
+        when no history exists.  First flag attaches a one-shot stack dump
+        and rides the task-event pipeline; the ray_tpu_suspected_hung_tasks
+        gauge tracks the live count."""
+        from ray_tpu._private import metrics as M
+
+        m_hung = M.Gauge("suspected_hung_tasks",
+                         "running tasks past their hang threshold, per node")
+        nid = self.node_id.hex()[:12]
+        while True:
+            interval = self._env_float("RAY_TPU_HANG_WATCHDOG_INTERVAL_S",
+                                       RayConfig.hang_watchdog_interval_s)
+            if interval <= 0:
+                await asyncio.sleep(2.0)
+                continue
+            await asyncio.sleep(interval)
+            try:
+                await self._hang_watchdog_tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("hang watchdog tick failed")
+            m_hung.set(len(self._suspected_hung), {"node": nid})
+
+    async def _hang_watchdog_tick(self):
+        threshold = self._env_float("RAY_TPU_HANG_THRESHOLD_S",
+                                    RayConfig.hang_threshold_s)
+        mult = RayConfig.hang_p95_multiplier
+        floor = RayConfig.hang_p95_floor_s
+        min_samples = RayConfig.hang_min_samples
+        events = []
+        seen: Set[Tuple[str, int]] = set()
+        for w in self._live_worker_conns():
+            try:
+                tasks = await w.conn.call("get_running_tasks", None,
+                                          timeout=10)
+            except (ConnectionError, rpc.ConnectionLost,
+                    asyncio.TimeoutError):
+                continue
+            for t in tasks:
+                key = (t["task_id"], t.get("attempt", 0))
+                seen.add(key)
+                p95, samples = t.get("p95_s"), t.get("samples", 0)
+                elapsed = t["elapsed_s"]
+                limit = threshold
+                if p95 is not None and samples >= min_samples:
+                    limit = min(limit, max(mult * p95, floor))
+                if elapsed <= limit or key in self._suspected_hung:
+                    continue
+                stack = await self._task_stack(w, t["task_id"])
+                self._suspected_hung[key] = {
+                    "worker_id": w.worker_id.hex(), "flagged_at": time.time()}
+                logger.warning(
+                    "task %s (%s) has been running %.1fs (threshold %.1fs): "
+                    "suspected hung; stack attached to its task row",
+                    t["task_id"][:16], t["name"], elapsed, limit)
+                events.append({
+                    "task_id": t["task_id"], "attempt": t.get("attempt", 0),
+                    "name": t["name"], "state": "HUNG", "ts": time.time(),
+                    "node_id": self.node_id.hex(),
+                    "worker_id": w.worker_id.hex(),
+                    "elapsed_s": round(elapsed, 3),
+                    "threshold_s": round(limit, 3),
+                    "stack": stack,
+                })
+        # a flagged task that stopped running (finished/failed/worker died)
+        # clears here; its terminal lifecycle event clears the state fold
+        for key in [k for k in self._suspected_hung if k not in seen]:
+            del self._suspected_hung[key]
+        if events:
+            try:
+                await self.gcs.notify("add_task_events", {"events": events})
+            except ConnectionError:
+                pass
+
+    async def _task_stack(self, w: WorkerHandle, task_id: str):
+        """One-shot stack dump of the worker, reduced to the executing
+        task's thread (whole-process dump as fallback for async tasks)."""
+        try:
+            dump = await w.conn.call("dump_stacks", None, timeout=10)
+        except (ConnectionError, rpc.ConnectionLost, asyncio.TimeoutError):
+            return None
+        for t in dump.get("threads", []):
+            if t.get("task_id") == task_id:
+                return t["stack"]
+        from ray_tpu._private.introspect import format_stack_payload
+
+        return format_stack_payload(dump)
 
     async def _flush_dir_loop(self):
         while True:

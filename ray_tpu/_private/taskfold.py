@@ -52,6 +52,24 @@ def fold_task_events(events, limit: int = 1000,
             "parent_span_id": ev.get("parent_span_id"),
             "state_ts": {},
         })
+        if ev["state"] == "HUNG":
+            # Watchdog annotation (nodelet-emitted): suspected-hang flag +
+            # one-shot stack, merged without disturbing the lifecycle state
+            # machine — the task is still RUNNING; its terminal event is
+            # what clears the flag from the hang views.
+            row["hung"] = {
+                "ts": ev["ts"],
+                "elapsed_s": ev.get("elapsed_s"),
+                "threshold_s": ev.get("threshold_s"),
+                "stack": ev.get("stack"),
+            }
+            for k in ("node_id", "worker_id"):
+                if ev.get(k) is not None:
+                    row[k] = ev[k]
+            # only running tasks get flagged; if the lifecycle events were
+            # dropped (buffer cap) the row must still carry a state
+            row.setdefault("state", "RUNNING")
+            continue
         if ev["state"] == "PHASES":
             # Phase-breakdown annotation emitted by the driver when the
             # completion lands: merged into the row without disturbing the

@@ -26,12 +26,18 @@ def main(argv=None):
     logging.basicConfig(
         level=logging.INFO, format="[worker] %(levelname)s %(message)s")
 
-    # SIGUSR1 dumps all thread stacks to stderr -> worker log (the `ray stack`
-    # equivalent, reference: python/ray/scripts/scripts.py stack command).
+    # SIGUSR1 dumps all thread stacks to stderr -> worker log (out-of-band
+    # fallback when the RPC plane is wedged; the primary live-stack surface
+    # is the nodelet's dump_stacks RPC served by CoreWorker, which feeds
+    # `ray_tpu stack` / the dashboard with zero external deps).
     import faulthandler
     import signal
+    import threading
 
     faulthandler.register(signal.SIGUSR1, all_threads=True)
+    # name the main thread so stack dumps read as "what is this thread FOR"
+    # rather than a bare MainThread parked on the shutdown event
+    threading.current_thread().name = "worker-main-wait"
 
     from ray_tpu._private import worker as worker_mod
     from ray_tpu._private.core_worker import CoreWorker

@@ -28,6 +28,11 @@ aiohttp app serving
                               (ray_tpu_data_* series)
     GET /api/train          — per-experiment Train view
                               (ray_tpu_train_* series)
+    GET /api/hangs          — suspected-hung tasks (watchdog-flagged rows
+                              still running, with the stack attached at
+                              flag time)
+    GET /api/stacks         — live Python stacks   (?node_id=...&task_id=...)
+                              proxied GCS → nodelet → per-process sampler
     GET /api/logs           — log files on a node   (?node_id=...)
     GET /api/log            — tail one log file     (?node_id=...&name=...)
 
@@ -143,7 +148,7 @@ function rate(vals, interval) {
 async function load() {
   try {
     const [nodes, metrics, actors, jobs, status, tasks, summary, history,
-           serveV, dataV, trainV] =
+           serveV, dataV, trainV, hangs] =
       await Promise.all([
         fetch('/api/nodes').then(r => r.json()),
         fetch('/api/node_metrics').then(r => r.json()),
@@ -156,6 +161,7 @@ async function load() {
         fetch('/api/serve').then(r => r.json()),
         fetch('/api/data').then(r => r.json()),
         fetch('/api/train').then(r => r.json()),
+        fetch('/api/hangs').then(r => r.json()),
       ]);
     let html = '<h2>Nodes</h2><table><tr><th>node</th><th>name</th>' +
       '<th>alive</th><th>CPU</th><th>mem</th><th>object store</th>' +
@@ -261,6 +267,22 @@ async function load() {
           `<td>${d.checkpoint_p50_s.toFixed(3)}</td>` +
           `<td>${spark(rate(series('reports'), ivl), null, '#7a4ad4')}` +
           `</td></tr>`;
+      }
+      html += '</table>';
+    }
+    if (hangs.length) {
+      html += '<h2 style="color:#b00">Suspected hung tasks</h2>' +
+        '<table><tr><th>task</th><th>name</th><th>node</th>' +
+        '<th>elapsed s</th><th>threshold s</th></tr>';
+      for (const h of hangs) {
+        html += `<tr><td>${esc(h.task_id.slice(0, 16))}</td>` +
+          `<td>${esc(h.name)}</td>` +
+          `<td>${esc((h.node_id || '').slice(0, 8))}</td>` +
+          `<td>${(h.elapsed_s || 0).toFixed(1)}</td>` +
+          `<td>${(h.threshold_s || 0).toFixed(1)}</td></tr>`;
+        if (h.stack)
+          html += '<tr><td colspan="5"><details><summary>stack at flag ' +
+            `time</summary><pre>${esc(h.stack)}</pre></details></td></tr>`;
       }
       html += '</table>';
     }
@@ -511,6 +533,34 @@ class Dashboard:
                 per[row["state"]] = per.get(row["state"], 0) + 1
             return summary
 
+        def hangs():
+            """Watchdog-flagged tasks still running (same fold as
+            util.state.summarize_hangs — the dashboard must not import the
+            driver-side worker module)."""
+            out = []
+            for row in _folded_tasks():
+                hung = row.get("hung")
+                if not hung or row.get("state") in ("FINISHED", "FAILED"):
+                    continue
+                out.append({
+                    "task_id": row["task_id"],
+                    "attempt": row.get("attempt", 0),
+                    "name": row.get("name"),
+                    "node_id": row.get("node_id"),
+                    "worker_id": row.get("worker_id"),
+                    "flagged_ts": hung.get("ts"),
+                    "elapsed_s": hung.get("elapsed_s"),
+                    "threshold_s": hung.get("threshold_s"),
+                    "stack": hung.get("stack"),
+                })
+            out.sort(key=lambda r: r.get("flagged_ts") or 0.0)
+            return out
+
+        def stacks(request):
+            return self._call("dump_stacks", {
+                "node_id": request.query.get("node_id"),
+                "task_id": request.query.get("task_id")})
+
         def history_sample():
             """One ring-buffer sample: per-node utilization + task-state
             counts + compact library series (blocking; runs on an executor
@@ -585,6 +635,8 @@ class Dashboard:
         app.router.add_get("/api/cluster_status", offload(cluster_status))
         app.router.add_get("/api/tasks", offload(tasks))
         app.router.add_get("/api/task_summary", offload(task_summary))
+        app.router.add_get("/api/hangs", offload(hangs))
+        app.router.add_get("/api/stacks", offload(stacks))
         app.router.add_get("/api/history", offload(history))
         app.router.add_get("/api/serve", offload(serve_view))
         app.router.add_get("/api/data", offload(data_view))

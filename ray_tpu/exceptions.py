@@ -152,3 +152,17 @@ class WorkerCrashedError(RayError):
 
 class CollectiveError(RayError):
     """A collective operation failed (peer death, timeout, shape mismatch)."""
+
+
+class CollectiveTimeout(CollectiveError):
+    """A collective op timed out waiting for peers.  Carries the group, the
+    op, and the rank(s) whose per-rank progress (stamped through the KV
+    rendezvous) lags the timed-out caller — the straggler diagnosis a bare
+    hang can never give."""
+
+    def __init__(self, message: str, group: str = "", op: str = "",
+                 lagging_ranks=()):
+        super().__init__(message)
+        self.group = group
+        self.op = op
+        self.lagging_ranks = tuple(lagging_ranks)

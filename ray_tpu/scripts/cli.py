@@ -15,7 +15,9 @@ Usage:
     python -m ray_tpu job list/status/logs/stop [ID]
     python -m ray_tpu timeline [--output PATH]
     python -m ray_tpu profile [--name TASK]
-    python -m ray_tpu summary tasks|serve|data|train
+    python -m ray_tpu summary tasks|serve|data|train|hangs
+    python -m ray_tpu stack [TASK_ID] [--node NODE_ID]
+    python -m ray_tpu logs FILE --follow
 """
 
 from __future__ import annotations
@@ -226,7 +228,26 @@ def _cmd_summary(args) -> int:
         _print_data_summary(state.summarize_data())
     elif args.what == "train":
         _print_train_summary(state.summarize_train())
+    elif args.what == "hangs":
+        _print_hangs_summary(state.summarize_hangs())
     return 0
+
+
+def _print_hangs_summary(hangs: list) -> None:
+    if not hangs:
+        print("no suspected hung tasks")
+        return
+    print(f"{'task':34} {'name':20} {'node':10} {'elapsed s':>10} "
+          f"{'threshold s':>12}")
+    for h in hangs:
+        print(f"{h['task_id'][:32]:34} {(h['name'] or '?')[:20]:20} "
+              f"{(h['node_id'] or '?')[:8]:10} {h['elapsed_s'] or 0:>10.1f} "
+              f"{h['threshold_s'] or 0:>12.1f}")
+    for h in hangs:
+        if h.get("stack"):
+            print(f"\nstack of {h['task_id'][:16]} ({h['name']}) "
+                  f"at flag time:")
+            print(h["stack"].rstrip())
 
 
 def _print_serve_summary(summary: dict) -> None:
@@ -274,10 +295,11 @@ def _print_train_summary(summary: dict) -> None:
         print("no train metrics recorded yet")
         return
     print(f"{'experiment':40} {'state':>9} {'workers':>8} {'reports':>8} "
-          f"{'rounds':>7} {'ckpts':>6} {'ckpt p50 s':>11}")
+          f"{'rounds':>7} {'skew':>5} {'ckpts':>6} {'ckpt p50 s':>11}")
     for name, d in sorted(summary.items()):
         print(f"{name:40} {d['gang_state']:>9} {d['workers']:>8g} "
               f"{d['reports']:>8g} {d['report_rounds']:>7g} "
+              f"{d.get('step_skew', 0):>5g} "
               f"{d['checkpoints']:>6g} {d['checkpoint_p50_s']:>11.3f}")
 
 
@@ -332,21 +354,78 @@ def _cmd_memory(args) -> int:
     return 0
 
 
+def _cmd_stack(args) -> int:
+    """Live Python stacks of cluster processes (reference: `ray stack`,
+    which shells out to py-spy; here every process samples itself via
+    sys._current_frames() over the RPC plane — zero external deps).  With a
+    TASK_ID, prints the stack of the worker executing that task."""
+    import ray_tpu
+    from ray_tpu._private.introspect import format_stack_payload
+    from ray_tpu.util import state
+
+    address = _resolve_address(args.address)
+    ray_tpu.init(address=address, ignore_reinit_error=True)
+    dumps = state.get_stacks(node_id=args.node, task_id=args.task_id)
+    if not dumps:
+        where = f"task {args.task_id}" if args.task_id else "cluster"
+        print(f"no stacks found for {where} (task finished, or no "
+              f"matching node)")
+        return 1
+    for node in dumps:
+        nid = node.get("node_id")
+        print(f"==== node {nid[:12] if nid else '<driver>'} ====")
+        for payload in node.get("workers", []):
+            print(format_stack_payload(payload))
+            print()
+        if node.get("nodelet"):
+            print(format_stack_payload(node["nodelet"]))
+            print()
+    return 0
+
+
 def _cmd_logs(args) -> int:
     """List/tail log files across the cluster (reference:
-    python/ray/_private/log_monitor.py + `ray logs` in scripts.py)."""
+    python/ray/_private/log_monitor.py + `ray logs` in scripts.py).
+    ``--follow`` poll-tails the file through the same state.get_log path,
+    so hang debugging doesn't require re-running the command."""
     import ray_tpu
     from ray_tpu.util import state
 
     address = _resolve_address(args.address)
     ray_tpu.init(address=address, ignore_reinit_error=True)
     if args.filename is None:
+        if args.follow:
+            raise SystemExit("--follow requires a log file name")
         for f in state.list_logs(node_id=args.node_id):
             print(f"{f['size']:>10}  {f['name']}")
         return 0
-    sys.stdout.write(state.get_log(args.filename, node_id=args.node_id,
-                                   tail=args.tail))
-    return 0
+    if not args.follow:
+        sys.stdout.write(state.get_log(args.filename, node_id=args.node_id,
+                                       tail=args.tail))
+        return 0
+    # follow: print the current tail, then poll the file's size and fetch
+    # only the newly-appended bytes each round (size from list_logs, bytes
+    # via the bounded get_log tail — no new RPC surface needed)
+    seen = None
+    try:
+        while True:
+            sizes = {f["name"]: f["size"]
+                     for f in state.list_logs(node_id=args.node_id)}
+            size = sizes.get(args.filename)
+            if size is not None:
+                if seen is None or size < seen:  # first round / truncated
+                    sys.stdout.write(state.get_log(
+                        args.filename, node_id=args.node_id, tail=args.tail))
+                    seen = size
+                elif size > seen:
+                    sys.stdout.write(state.get_log(
+                        args.filename, node_id=args.node_id,
+                        tail=size - seen))
+                    seen = size
+                sys.stdout.flush()
+            time.sleep(args.poll_interval)
+    except KeyboardInterrupt:
+        return 0
 
 
 def _cmd_job(args) -> int:
@@ -451,11 +530,23 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("summary",
                        help="summarize cluster entities "
-                            "(tasks, serve, data, train)")
-    p.add_argument("what", choices=["tasks", "serve", "data", "train"],
+                            "(tasks, serve, data, train, hangs)")
+    p.add_argument("what",
+                   choices=["tasks", "serve", "data", "train", "hangs"],
                    help="entity kind to summarize")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=_cmd_summary)
+
+    p = sub.add_parser("stack",
+                       help="dump live Python stacks of cluster processes "
+                            "(optionally of the worker running one task)")
+    p.add_argument("task_id", nargs="?", default=None,
+                   help="task id (hex prefix ok): only the worker "
+                        "executing it")
+    p.add_argument("--node", default=None,
+                   help="node id (hex prefix ok); default: every node")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=_cmd_stack)
 
     p = sub.add_parser("memory",
                        help="per-node object-store usage + spill counters")
@@ -472,6 +563,10 @@ def main(argv=None) -> int:
                    help="node id (hex prefix ok); default: head node")
     p.add_argument("--tail", type=int, default=64 * 1024,
                    help="bytes from the end of the file")
+    p.add_argument("--follow", "-f", action="store_true",
+                   help="poll-tail the file until interrupted")
+    p.add_argument("--poll-interval", type=float, default=1.0,
+                   help="seconds between --follow polls")
     p.set_defaults(fn=_cmd_logs)
 
     p = sub.add_parser("job", help="submit and manage jobs")

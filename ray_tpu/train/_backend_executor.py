@@ -38,6 +38,8 @@ class BackendExecutor:
         self._backend = backend_config.backend_cls()
         self._scaling_config = scaling_config
         self.worker_group: Optional[WorkerGroup] = None
+        self._experiment = ""  # heartbeat key space, set by start_training
+        self._experiment_label = ""
 
     # ---------------------------------------------------------- lifecycle
     def start(self) -> None:
@@ -61,6 +63,10 @@ class BackendExecutor:
                        dataset_shards: Optional[list] = None) -> None:
         assert self.worker_group is not None, "call start() first"
         wg = self.worker_group
+        # heartbeat KV key space (must mirror _TrainSession._stamp_heartbeat)
+        # vs metric label (must mirror the other train_* series' label)
+        self._experiment = experiment_name or trial_name or "default"
+        self._experiment_label = experiment_name or ""
         self._backend.on_training_start(wg, self._backend_config)
 
         # local ranks: position among the workers sharing a node (reference:
@@ -106,6 +112,7 @@ class BackendExecutor:
         results: List[Optional[_TrainingResult]] = [None] * len(wg)
         deadline = time.monotonic() + timeout_s
         while any(r is None for r in results):
+            self._observe_gang_skew()
             if time.monotonic() > deadline:
                 raise TrainingFailedError(
                     f"no report() from workers "
@@ -139,6 +146,34 @@ class BackendExecutor:
                 f"report()ing — all workers must report the same number of "
                 f"times")
         return results  # type: ignore[return-value]
+
+    def _observe_gang_skew(self) -> None:
+        """Fold the workers' per-rank step heartbeats (stamped into the GCS
+        KV by _TrainSession.report) into the ray_tpu_train_gang_step_skew
+        gauge.  Runs on each driver poll round, i.e. exactly while the
+        driver is waiting on the gang — when skew matters."""
+        import json
+
+        from ray_tpu._private.worker import global_worker_core
+        from ray_tpu.train._metrics import train_metrics
+
+        core = global_worker_core()
+        if core is None or self.worker_group is None:
+            return
+        try:
+            vals = core.gcs_call_sync("kv_multi_get", {
+                "ns": "train",
+                "keys": [f"train/{self._experiment}/heartbeat/{r}"
+                         for r in range(len(self.worker_group))],
+            }, timeout=10)
+            steps = [json.loads(v)["step"] for v in vals.values()]
+        except Exception:
+            return  # a GCS hiccup must not fail the training loop
+        if not steps:
+            return
+        train_metrics()["step_skew"].set(
+            max(steps) - min(steps) if len(steps) > 1 else 0.0,
+            {"experiment": self._experiment_label})
 
     def shutdown(self) -> None:
         if self.worker_group is None:

@@ -47,6 +47,14 @@ def train_metrics() -> Dict[str, M.Metric]:
                     "gang_workers": M.Gauge(
                         "train_gang_workers",
                         "world size of the running gang, per experiment"),
+                    "rank_step": M.Gauge(
+                        "train_rank_step",
+                        "last report() step begun, per experiment and rank "
+                        "(worker-side heartbeat)"),
+                    "step_skew": M.Gauge(
+                        "train_gang_step_skew",
+                        "max-min report step across the gang's ranks, per "
+                        "experiment (straggler indicator)"),
                     "ckpt_persist": M.Histogram(
                         "train_checkpoint_persist_seconds",
                         "report()-side checkpoint persist duration, per "

@@ -87,6 +87,10 @@ class _TrainSession:
         # by the driver): restarting at 0 would merge fresh state into stale
         # same-numbered dirs.
         self._checkpoint_seq = checkpoint_seq_start
+        # report() round counter: stamped into gang state (KV + gauge) at
+        # each report START, so one slow rank shows as step skew while its
+        # peers sit blocked in the lockstep queue.
+        self._step = 0
         self._thread = threading.Thread(
             target=self._run, args=(train_fn, config), daemon=True,
             name="train-loop")
@@ -122,6 +126,10 @@ class _TrainSession:
         m = train_metrics()
         labels = {"experiment": self.context.experiment_name or ""}
         m["reports"].inc(1, labels)
+        self._step += 1
+        m["rank_step"].set(self._step, {
+            **labels, "rank": str(self.context.world_rank)})
+        self._stamp_heartbeat()
         persisted = None
         if checkpoint is not None:
             t0 = _time.perf_counter()
@@ -129,6 +137,32 @@ class _TrainSession:
             m["ckpt_persist"].observe(_time.perf_counter() - t0, labels)
         self._result_q.put(_TrainingResult(dict(metrics), persisted))
         self._consumed.acquire()  # lockstep with the driver (reference :403)
+
+    def _stamp_heartbeat(self) -> None:
+        """Per-rank step heartbeat into gang state (GCS KV, fire-and-forget):
+        the driver's result loop folds these into the
+        ray_tpu_train_gang_step_skew gauge, so a straggling rank is visible
+        WHILE its peers block — lockstep results alone can't show skew."""
+        import json
+        import time as _time
+
+        from ray_tpu._private import worker as worker_mod
+
+        core = worker_mod.global_worker_core()
+        if core is None:
+            return  # plain-script report(): no runtime to stamp into
+        exp = self.context.experiment_name or self.context.trial_name or \
+            "default"
+        try:
+            core.io.spawn(core.gcs_conn.notify("kv_put", {
+                "ns": "train",
+                "key": f"train/{exp}/heartbeat/{self.context.world_rank}",
+                "value": json.dumps({"step": self._step,
+                                     "ts": _time.time()}).encode(),
+                "overwrite": True,
+            }))
+        except Exception:
+            pass  # heartbeats must never fail a report
 
     def _persist_checkpoint(self, checkpoint: Checkpoint) -> str:
         from ray_tpu.train import storage

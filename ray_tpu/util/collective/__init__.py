@@ -19,6 +19,7 @@ from ray_tpu.util.collective.collective import (
     broadcast,
     destroy_collective_group,
     get_collective_group_size,
+    get_group_progress,
     get_rank,
     init_collective_group,
     recv,
@@ -30,5 +31,5 @@ from ray_tpu.util.collective import xla
 __all__ = [
     "init_collective_group", "destroy_collective_group", "allreduce",
     "allgather", "reducescatter", "broadcast", "send", "recv", "barrier",
-    "get_rank", "get_collective_group_size", "xla",
+    "get_rank", "get_collective_group_size", "get_group_progress", "xla",
 ]

@@ -23,7 +23,7 @@ import numpy as np
 
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.config import RayConfig
-from ray_tpu.exceptions import CollectiveError
+from ray_tpu.exceptions import CollectiveError, CollectiveTimeout
 
 _groups: Dict[str, "Group"] = {}
 _lock = threading.Lock()
@@ -47,7 +47,17 @@ class Group:
         handler_name = f"col_{name}"
         self.core.server.handlers[handler_name] = self._on_message
         self._handler_name = handler_name
+        # Per-rank liveness: each op start stamps (seq, op, ts) into the KV
+        # rendezvous AND a local gauge, so a peer stuck waiting can name the
+        # rank whose progress lags (straggler diagnosis; reference:
+        # "Efficient AllReduce with Stragglers", arXiv:2505.23523).
+        from ray_tpu._private import metrics as M
+
+        self._m_seq = M.Gauge(
+            "collective_op_seq",
+            "last collective op sequence started, per group and rank")
         self._register()
+        self._stamp_progress("init", 0)
 
     # ------------------------------------------------------------ rendezvous
     def _kv(self, op, **kw):
@@ -85,27 +95,94 @@ class Group:
             self._inbox_cv.notify_all()
         return True
 
-    def _send_to(self, rank: int, data, seq: int, tag: int = 0):
+    def _deadline(self, timeout_s: Optional[float]) -> float:
+        if timeout_s is None:
+            timeout_s = RayConfig.collective_default_timeout_s
+        return time.monotonic() + timeout_s
+
+    def _send_to(self, rank: int, data, seq: int, tag: int = 0,
+                 deadline: Optional[float] = None):
+        timeout = RayConfig.collective_op_timeout_s if deadline is None \
+            else max(deadline - time.monotonic(), 0.001)
         self._conn(rank).call_sync(
             self._handler_name,
             {"seq": seq, "src": self.rank, "tag": tag, "data": data},
-            timeout=RayConfig.collective_op_timeout_s)
+            timeout=timeout)
 
-    def _recv_from(self, rank: int, seq: int, tag: int = 0):
+    def _recv_from(self, rank: int, seq: int, tag: int = 0,
+                   deadline: Optional[float] = None, op: str = "recv"):
         key = (seq, rank, tag)
-        deadline = time.monotonic() + RayConfig.collective_op_timeout_s
+        if deadline is None:
+            deadline = time.monotonic() + RayConfig.collective_op_timeout_s
         with self._inbox_cv:
             while not self._inbox.get(key):
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    raise CollectiveError(
-                        f"timeout waiting for rank {rank} in group {self.name!r}")
+                    break
                 self._inbox_cv.wait(min(remaining, 1.0))
-            q = self._inbox[key]
-            data = q.popleft()
-            if not q:
-                del self._inbox[key]
-            return data
+            else:
+                q = self._inbox[key]
+                data = q.popleft()
+                if not q:
+                    del self._inbox[key]
+                return data
+        # timed out: diagnose OUTSIDE the condition lock — naming the
+        # lagging rank costs a KV read and must not block inbox delivery
+        raise self._timeout_error(op, rank)
+
+    # ------------------------------------------------------ progress / hangs
+    def _stamp_progress(self, op: str, seq: int) -> None:
+        """Publish this rank's (seq, op) heartbeat: gauge locally (rides the
+        worker metrics push) + fire-and-forget KV write (what a stuck peer
+        reads to name us as lagging).  Never blocks the op."""
+        import pickle
+
+        self._m_seq.set(seq, {"group": self.name, "rank": str(self.rank)})
+        try:
+            self.core.io.spawn(self.core.gcs_conn.notify("kv_put", {
+                "ns": "collective",
+                "key": f"collective/{self.name}/progress/{self.rank}",
+                "value": pickle.dumps(
+                    {"seq": seq, "op": op, "ts": time.time()}),
+                "overwrite": True,
+            }))
+        except Exception:
+            pass  # diagnosis plumbing must never fail the collective
+
+    def progress(self) -> Dict[int, dict]:
+        """Every member's last stamped (seq, op, ts), from the KV
+        rendezvous; ranks that never stamped are absent."""
+        import pickle
+
+        vals = self._kv(
+            "kv_multi_get", ns="collective",
+            keys=[f"collective/{self.name}/progress/{r}"
+                  for r in range(self.world_size)])
+        out: Dict[int, dict] = {}
+        for r in range(self.world_size):
+            blob = vals.get(f"collective/{self.name}/progress/{r}")
+            if blob is not None:
+                out[r] = pickle.loads(blob)
+        return out
+
+    def _timeout_error(self, op: str, waiting_on: int) -> CollectiveTimeout:
+        try:
+            prog = self.progress()
+        except Exception:
+            prog = {}
+        lagging = [r for r in range(self.world_size)
+                   if r != self.rank
+                   and prog.get(r, {}).get("seq", -1) < self.seq]
+        detail = ", ".join(
+            f"rank {r} last at seq {prog[r]['seq']} ({prog[r]['op']})"
+            if r in prog else f"rank {r} never stamped progress"
+            for r in lagging) or f"rank {waiting_on} (no progress data)"
+        return CollectiveTimeout(
+            f"collective {op!r} in group {self.name!r} (rank {self.rank}, "
+            f"seq {self.seq}) timed out waiting for rank {waiting_on}; "
+            f"lagging: {detail}",
+            group=self.name, op=op,
+            lagging_ranks=lagging or [waiting_on])
 
     # ------------------------------------------------------------ primitives
     # Ring topology (bandwidth-optimal, like NCCL's host rings): allreduce =
@@ -123,7 +200,9 @@ class Group:
         raise ValueError(f"unsupported op {op!r}")
 
     def _ring_reduce_scatter(self, chunks: List[np.ndarray], op: str,
-                             seq: int, shift: int = 0) -> List[np.ndarray]:
+                             seq: int, shift: int = 0,
+                             deadline: Optional[float] = None,
+                             op_name: str = "reducescatter") -> List[np.ndarray]:
         """After N-1 steps, chunk[(rank + 1 + shift) % N] holds the full
         reduction (shift=-1 leaves rank r with shard r)."""
         n = self.world_size
@@ -132,13 +211,17 @@ class Group:
         for step in range(n - 1):
             send_idx = (self.rank - step + shift) % n
             recv_idx = (self.rank - step - 1 + shift) % n
-            self._send_to(right, chunks[send_idx], seq, tag=step)
-            incoming = np.asarray(self._recv_from(left, seq, tag=step))
+            self._send_to(right, chunks[send_idx], seq, tag=step,
+                          deadline=deadline)
+            incoming = np.asarray(self._recv_from(
+                left, seq, tag=step, deadline=deadline, op=op_name))
             chunks[recv_idx] = self._reduce_op(chunks[recv_idx], incoming, op)
         return chunks
 
     def _ring_allgather_chunks(self, chunks: List[np.ndarray], owned_idx: int,
-                               seq: int, tag_base: int) -> List[np.ndarray]:
+                               seq: int, tag_base: int,
+                               deadline: Optional[float] = None,
+                               op_name: str = "allgather") -> List[np.ndarray]:
         """Each rank starts holding chunk[owned_idx]; N-1 rotations fill all."""
         n = self.world_size
         right = (self.rank + 1) % n
@@ -146,13 +229,17 @@ class Group:
         for step in range(n - 1):
             send_idx = (owned_idx - step) % n
             recv_idx = (owned_idx - step - 1) % n
-            self._send_to(right, chunks[send_idx], seq, tag=tag_base + step)
-            chunks[recv_idx] = np.asarray(
-                self._recv_from(left, seq, tag=tag_base + step))
+            self._send_to(right, chunks[send_idx], seq, tag=tag_base + step,
+                          deadline=deadline)
+            chunks[recv_idx] = np.asarray(self._recv_from(
+                left, seq, tag=tag_base + step, deadline=deadline,
+                op=op_name))
         return chunks
 
-    def allreduce(self, array, op: str = "sum"):
-        seq = self._next_seq()
+    def allreduce(self, array, op: str = "sum",
+                  timeout_s: Optional[float] = None, _op_name: str = "allreduce"):
+        seq = self._next_seq(_op_name)
+        deadline = self._deadline(timeout_s)
         arr = np.asarray(array)
         n = self.world_size
         if n == 1:
@@ -160,18 +247,24 @@ class Group:
         acc_dtype = np.float64 if op in ("sum", "mean") else arr.dtype
         flat = arr.astype(acc_dtype).ravel()
         chunks = [c.copy() for c in np.array_split(flat, n)]
-        chunks = self._ring_reduce_scatter(chunks, op, seq)
+        chunks = self._ring_reduce_scatter(chunks, op, seq,
+                                           deadline=deadline,
+                                           op_name=_op_name)
         owned = (self.rank + 1) % n
         chunks = self._ring_allgather_chunks(chunks, owned, seq,
-                                             tag_base=1000)
+                                             tag_base=1000,
+                                             deadline=deadline,
+                                             op_name=_op_name)
         out = np.concatenate([np.asarray(c, dtype=acc_dtype).ravel()
                               for c in chunks])
         if op == "mean":
             out = out / n
         return out.astype(arr.dtype).reshape(arr.shape)
 
-    def allgather(self, array) -> List[np.ndarray]:
-        seq = self._next_seq()
+    def allgather(self, array,
+                  timeout_s: Optional[float] = None) -> List[np.ndarray]:
+        seq = self._next_seq("allgather")
+        deadline = self._deadline(timeout_s)
         arr = np.asarray(array)
         n = self.world_size
         if n == 1:
@@ -180,13 +273,15 @@ class Group:
         chunks: List[Any] = [None] * n
         chunks[self.rank] = arr
         chunks = self._ring_allgather_chunks(chunks, self.rank, seq,
-                                             tag_base=0)
+                                             tag_base=0, deadline=deadline)
         return [np.asarray(c) for c in chunks]
 
-    def reducescatter(self, array, op: str = "sum"):
+    def reducescatter(self, array, op: str = "sum",
+                      timeout_s: Optional[float] = None):
         """True ring reduce-scatter: each rank moves ~1x the payload and
         returns only its shard (v1 was allreduce-then-split: no saving)."""
-        seq = self._next_seq()
+        seq = self._next_seq("reducescatter")
+        deadline = self._deadline(timeout_s)
         arr = np.asarray(array)
         n = self.world_size
         if n == 1:
@@ -196,35 +291,44 @@ class Group:
         # a (4, 4) input with n=2 yields (2, 4) shards, not flat slices
         chunks = [c.copy() for c in
                   np.array_split(arr.astype(acc_dtype), n, axis=0)]
-        chunks = self._ring_reduce_scatter(chunks, op, seq, shift=-1)
+        chunks = self._ring_reduce_scatter(chunks, op, seq, shift=-1,
+                                           deadline=deadline)
         mine = chunks[self.rank]
         if op == "mean":
             mine = mine / n
         return np.asarray(mine).astype(arr.dtype)
 
-    def broadcast(self, array, root: int = 0):
-        seq = self._next_seq()
+    def broadcast(self, array, root: int = 0,
+                  timeout_s: Optional[float] = None):
+        seq = self._next_seq("broadcast")
+        deadline = self._deadline(timeout_s)
         if self.rank == root:
             arr = np.asarray(array)
             for r in range(self.world_size):
                 if r != root:
-                    self._send_to(r, arr, seq)
+                    self._send_to(r, arr, seq, deadline=deadline)
             return arr
-        return np.asarray(self._recv_from(root, seq))
+        return np.asarray(self._recv_from(root, seq, deadline=deadline,
+                                          op="broadcast"))
 
-    def barrier(self):
-        self.allreduce(np.zeros((), np.float32))
+    def barrier(self, timeout_s: Optional[float] = None):
+        self.allreduce(np.zeros((), np.float32), timeout_s=timeout_s,
+                       _op_name="barrier")
 
     def send(self, array, dst_rank: int, tag: int = 0):
         # Tagged p2p rides its own seq namespace (negative tags avoid
         # colliding with collective seqs).
         self._send_to(dst_rank, np.asarray(array), -1, tag=tag + 2)
 
-    def recv(self, src_rank: int, tag: int = 0):
-        return np.asarray(self._recv_from(src_rank, -1, tag=tag + 2))
+    def recv(self, src_rank: int, tag: int = 0,
+             timeout_s: Optional[float] = None):
+        return np.asarray(self._recv_from(
+            src_rank, -1, tag=tag + 2,
+            deadline=self._deadline(timeout_s), op="recv"))
 
-    def _next_seq(self) -> int:
+    def _next_seq(self, op: str = "op") -> int:
         self.seq += 1
+        self._stamp_progress(op, self.seq)
         return self.seq
 
     def destroy(self):
@@ -294,9 +398,24 @@ def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0):
     _group(group_name).send(tensor, dst_rank, tag)
 
 
-def recv(src_rank: int, group_name: str = "default", tag: int = 0):
-    return _group(group_name).recv(src_rank, tag)
+def recv(src_rank: int, group_name: str = "default", tag: int = 0,
+         timeout_s: Optional[float] = None):
+    """Blocking p2p receive.  ``timeout_s`` (default
+    RayConfig.collective_default_timeout_s, env
+    RAY_TPU_COLLECTIVE_DEFAULT_TIMEOUT_S) bounds the wait; on expiry
+    CollectiveTimeout names the group, op, and lagging rank(s) instead of
+    hanging forever."""
+    return _group(group_name).recv(src_rank, tag, timeout_s=timeout_s)
 
 
-def barrier(group_name: str = "default"):
-    _group(group_name).barrier()
+def barrier(group_name: str = "default",
+            timeout_s: Optional[float] = None):
+    """Full-group barrier.  ``timeout_s`` semantics as in :func:`recv` — a
+    gang with one absent rank raises CollectiveTimeout naming that rank."""
+    _group(group_name).barrier(timeout_s=timeout_s)
+
+
+def get_group_progress(group_name: str = "default") -> Dict[int, dict]:
+    """Per-rank collective progress {rank: {seq, op, ts}} from the KV
+    rendezvous — which rank is behind, without interrupting anyone."""
+    return _group(group_name).progress()

@@ -188,6 +188,55 @@ def summarize_train() -> Dict[str, Any]:
     return mv.summarize_train(_collect_metric_samples())
 
 
+def get_stacks(node_id: Optional[str] = None,
+               task_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Live Python stacks across the cluster (the `ray_tpu stack` payload).
+
+    Routed through the GCS, which fans out to each nodelet's ``dump_stacks``
+    RPC; every worker samples its own threads via ``sys._current_frames()``
+    — no py-spy, no ptrace.  ``node_id`` (hex prefix) narrows to one node;
+    ``task_id`` narrows to the worker(s) currently executing that task (the
+    returned threads carry ``task_id``/``task_name`` where attributable).
+    Returns one payload per node: {node_id, addr, workers: [...], nodelet?}.
+    """
+    core = require_core()
+    out = core.gcs_call_sync(
+        "dump_stacks", {"node_id": node_id, "task_id": task_id}, timeout=30)
+    if task_id:
+        out = [p for p in out if p.get("workers")]
+    elif node_id is None:
+        # the driver isn't under any nodelet: sample it locally so
+        # "stacks of everything" really is everything
+        out.append({"node_id": None, "addr": None,
+                    "workers": [core.capture_stacks()]})
+    return out
+
+
+def summarize_hangs() -> List[Dict[str, Any]]:
+    """Suspected-hung tasks: rows the nodelet watchdog flagged (running
+    past their hang threshold) that have not yet finished, each with the
+    one-shot stack the watchdog attached at flag time."""
+    out = []
+    for row in list_tasks(limit=100_000):
+        hung = row.get("hung")
+        if not hung or row.get("state") in ("FINISHED", "FAILED"):
+            continue
+        out.append({
+            "task_id": row["task_id"],
+            "attempt": row.get("attempt", 0),
+            "name": row.get("name"),
+            "state": row.get("state"),
+            "node_id": row.get("node_id"),
+            "worker_id": row.get("worker_id"),
+            "flagged_ts": hung.get("ts"),
+            "elapsed_s": hung.get("elapsed_s"),
+            "threshold_s": hung.get("threshold_s"),
+            "stack": hung.get("stack"),
+        })
+    out.sort(key=lambda r: r.get("flagged_ts") or 0.0)
+    return out
+
+
 def _nodelet_call(node_id: Optional[str], method: str, msg=None):
     """RPC straight to one node's nodelet (address from the GCS node table).
     ``node_id=None`` targets the first alive node."""

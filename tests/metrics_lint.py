@@ -86,3 +86,28 @@ def lint_runtime() -> List[str]:
     data_metrics()
     train_metrics()
     return M.validate_registry(M.default_registry)
+
+
+# Metric names that appear in source only as documentation examples
+# (docstrings showing the user-defined metrics API) — not exported series.
+_DOC_EXAMPLE_NAMES = {"cache_hits"}
+
+_ARCHITECTURE_MD = os.path.join(
+    os.path.dirname(RAY_TPU_ROOT), "docs", "ARCHITECTURE.md")
+
+
+def lint_docs() -> List[str]:
+    """Every metric the tree constructs must appear in the ARCHITECTURE.md
+    exported-series table (§5b): an undocumented series is invisible to
+    operators and silently rots when renamed."""
+    with open(_ARCHITECTURE_MD, encoding="utf-8") as f:
+        doc = f.read()
+    problems = []
+    for rel, kind, name, _desc in collect_source_metrics():
+        if name in _DOC_EXAMPLE_NAMES:
+            continue
+        if name not in doc:
+            problems.append(
+                f"{rel}: {kind}({name!r}) is not documented in "
+                "docs/ARCHITECTURE.md's exported-series table")
+    return problems

@@ -50,6 +50,17 @@ class Member:
     def recv_many(self, src, n, tag=0):
         return [self.col.recv(src, group_name=self._g(), tag=tag) for _ in range(n)]
 
+    def barrier_timeout(self, timeout_s):
+        self.col.barrier(group_name=self._g(), timeout_s=timeout_s)
+        return True
+
+    def recv_timeout(self, src, timeout_s, tag=0):
+        return self.col.recv(src, group_name=self._g(), tag=tag,
+                             timeout_s=timeout_s)
+
+    def group_progress(self):
+        return self.col.get_group_progress(self._g())
+
     def set_group(self, name):
         self._group = name
 
@@ -205,3 +216,50 @@ def test_reducescatter_2d_shape_parity(members):
     for r, o in enumerate(outs):
         np.testing.assert_allclose(o, np.array_split(full, WORLD, axis=0)[r])
         assert o.shape == (2, 3)
+
+
+# --------------------------------------------------- timeouts / stragglers
+
+def _fresh_group(n, prefix):
+    """Dedicated actors + group: a timed-out collective leaves per-rank seq
+    counters misaligned, so these tests must never share the module group."""
+    import uuid
+
+    name = f"{prefix}-{uuid.uuid4().hex[:6]}"
+    actors = [Member.remote(r, n, name) for r in range(n)]
+    ray_tpu.get([a.init_done.remote(name) for a in actors])
+    return actors
+
+
+def test_barrier_timeout_names_absent_rank(ray_start_regular):
+    """A barrier with one rank missing raises CollectiveTimeout naming that
+    rank (ISSUE 3 acceptance) instead of hanging forever."""
+    from ray_tpu.exceptions import CollectiveTimeout
+
+    actors = _fresh_group(3, "tmo-barrier")
+    try:
+        # ranks 0 and 1 enter the barrier; rank 2 never does
+        refs = [actors[0].barrier_timeout.remote(3.0),
+                actors[1].barrier_timeout.remote(3.0)]
+        for ref in refs:
+            with pytest.raises(CollectiveTimeout, match="rank 2"):
+                ray_tpu.get(ref)
+        # progress through the KV rendezvous names the straggler: rank 2 is
+        # still at the init stamp while 0/1 advanced to the barrier seq
+        prog = ray_tpu.get(actors[0].group_progress.remote())
+        assert prog[2]["seq"] < prog[0]["seq"]
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def test_recv_timeout_raises_instead_of_blocking(ray_start_regular):
+    from ray_tpu.exceptions import CollectiveTimeout
+
+    actors = _fresh_group(2, "tmo-recv")
+    try:
+        with pytest.raises(CollectiveTimeout, match="rank 1"):
+            ray_tpu.get(actors[0].recv_timeout.remote(1, 2.0))
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)

@@ -160,3 +160,25 @@ def test_state_log_api(cluster):
     if files:
         text = state.get_log(files[0]["name"], tail=1024)
         assert isinstance(text, str)
+
+
+def test_hangs_and_stacks_endpoints(cluster):
+    """/api/hangs is well-formed when nothing hangs; /api/stacks serves the
+    GCS-proxied per-node thread dumps (ISSUE 3 live-introspection layer)."""
+    dash, port = _start_dashboard()
+
+    def get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            return json.loads(r.read())
+
+    hangs = get("/api/hangs")
+    assert isinstance(hangs, list)
+    for h in hangs:  # flagged rows (if an earlier suite left one) are shaped
+        assert {"task_id", "elapsed_s", "stack"} <= set(h)
+    stacks = get("/api/stacks")
+    assert isinstance(stacks, list) and stacks
+    for node in stacks:
+        assert "node_id" in node and "workers" in node
+        for w in node["workers"]:
+            assert isinstance(w["threads"], list)

@@ -6,7 +6,8 @@ and `_private.metrics.validate_registry`)."""
 import pytest
 
 from ray_tpu._private import metrics as M
-from metrics_lint import collect_source_metrics, lint_runtime, lint_source
+from metrics_lint import (collect_source_metrics, lint_docs, lint_runtime,
+                          lint_source)
 
 
 def test_source_walk_finds_the_known_definition_sites():
@@ -15,8 +16,15 @@ def test_source_walk_finds_the_known_definition_sites():
     names = {name for _rel, _kind, name, _d in collect_source_metrics()}
     for expected in ("serve_request_latency_seconds", "data_rows_output_total",
                      "train_report_total", "node_resources_total",
-                     "task_phase_seconds"):
+                     "task_phase_seconds",
+                     # ISSUE 3 hang-diagnosis series
+                     "suspected_hung_tasks", "collective_op_seq",
+                     "train_rank_step", "train_gang_step_skew"):
         assert expected in names, f"walker missed {expected}"
+
+
+def test_every_source_metric_is_documented():
+    assert lint_docs() == []
 
 
 def test_source_metric_definitions_are_hygienic():
