@@ -116,6 +116,42 @@ def _tpu_reachable(timeout_s: float = 60.0) -> bool:
         return False
 
 
+def _llm_decode_bench(num_requests: int = 8, prompt_len: int = 32,
+                      max_tokens: int = 32) -> dict:
+    """Continuous-batching decode throughput + TTFT of the tiny-model
+    engine (ray_tpu.llm): submit a burst, step inline to completion."""
+    import numpy as np
+
+    from ray_tpu.llm.engine import EngineCore
+    from ray_tpu.llm.scheduler import SamplingParams
+
+    core = EngineCore(engine_name="bench", num_pages=256, page_size=16,
+                      max_batch_tokens=512)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, core.config.vocab_size,
+                            prompt_len).tolist()
+               for _ in range(num_requests)]
+    t0 = time.perf_counter()
+    rids = [core.submit(p, SamplingParams(max_tokens=max_tokens))
+            for p in prompts]
+    core.run_until_done(rids)
+    dt = time.perf_counter() - t0
+    reqs = [core._requests[r] for r in rids]
+    ttfts = [r.first_token_at - r.submitted_at for r in reqs
+             if r.first_token_at is not None]
+    stats = core.stats()
+    return {
+        "tokens_per_sec": round(stats["total_generated"] / dt, 1),
+        "ttft_mean_s": round(sum(ttfts) / len(ttfts), 4) if ttfts else None,
+        "requests": num_requests,
+        "prompt_len": prompt_len,
+        "max_tokens": max_tokens,
+        "max_decode_batch": stats["max_decode_batch"],
+        "preemptions": stats["preemptions"],
+        "backend": core.cache.backend,
+    }
+
+
 def main() -> None:
     import sys
     import time as _time
@@ -335,6 +371,17 @@ def main() -> None:
             }
         except Exception as e:
             result["watchdog_overhead"] = {"error": repr(e)}
+
+    # LLM continuous-batching decode throughput (ISSUE 4): tiny model on
+    # the numpy engine — in-process (no runtime), so the number isolates
+    # scheduler+cache+runner cost.  Recorded on every platform; the engine
+    # backend is host-side either way (the TPU paged-attention path is the
+    # planned upgrade), so the row is tagged with the backend it measured.
+    if os.environ.get("RAY_TPU_BENCH_LLM", "1") != "0":
+        try:
+            result["llm_decode_throughput"] = _llm_decode_bench()
+        except Exception as e:
+            result["llm_decode_throughput"] = {"error": repr(e)}
 
     if result.get("platform") == "tpu":
         result["source"] = "live"

@@ -277,6 +277,54 @@ def summarize_train(samples: List[Sample]) -> Dict[str, Dict]:
     return out
 
 
+# ------------------------------------------------------------- llm view
+
+def summarize_llm(samples: List[Sample]) -> Dict[str, Dict[str, float]]:
+    """Per-engine LLM view: request/token counters, TTFT and inter-token
+    latency percentiles, decode-batch occupancy, KV-page utilization,
+    preemptions, queue depth and throughput — the serving-side signals the
+    continuous-batching engine exports (ray_tpu_llm_* series)."""
+    keys = ("engine",)
+    req = _sum_by(samples, "ray_tpu_llm_requests_total", keys)
+    ptoks = _sum_by(samples, "ray_tpu_llm_prompt_tokens_total", keys)
+    toks = _sum_by(samples, "ray_tpu_llm_tokens_generated_total", keys)
+    preempt = _sum_by(samples, "ray_tpu_llm_preemptions_total", keys)
+    queue = _sum_by(samples, "ray_tpu_llm_queue_depth", keys)
+    running = _sum_by(samples, "ray_tpu_llm_running_requests", keys)
+    util = _max_by(samples, "ray_tpu_llm_kv_page_utilization", keys)
+    tps = _max_by(samples, "ray_tpu_llm_tokens_per_second", keys)
+    ttft = _hist_by(samples, "ray_tpu_llm_ttft_seconds", keys)
+    itl = _hist_by(samples, "ray_tpu_llm_inter_token_seconds", keys)
+    batch = _hist_by(samples, "ray_tpu_llm_decode_batch_size", keys)
+    out: Dict[str, Dict[str, float]] = {}
+    for joined, k in _joined(set(req) | set(toks) | set(ptoks) | set(queue)
+                             | set(running) | set(util) | set(tps)
+                             | set(preempt) | set(ttft) | set(itl)
+                             | set(batch)):
+        t = ttft.get(k, {})
+        i = itl.get(k, {})
+        b = batch.get(k, {})
+        out[joined] = {
+            "requests": req.get(k, 0.0),
+            "prompt_tokens": ptoks.get(k, 0.0),
+            "generated_tokens": toks.get(k, 0.0),
+            "tokens_per_second": tps.get(k, 0.0),
+            "ttft_mean_s": t.get("mean", 0.0),
+            "ttft_p50_s": t.get("p50", 0.0),
+            "ttft_p95_s": t.get("p95", 0.0),
+            "ttft_p99_s": t.get("p99", 0.0),
+            "itl_p50_s": i.get("p50", 0.0),
+            "itl_p95_s": i.get("p95", 0.0),
+            "itl_p99_s": i.get("p99", 0.0),
+            "decode_batch_mean": b.get("mean", 0.0),
+            "kv_page_utilization": util.get(k, 0.0),
+            "preemptions": preempt.get(k, 0.0),
+            "queue_depth": queue.get(k, 0.0),
+            "running": running.get(k, 0.0),
+        }
+    return out
+
+
 # --------------------------------------------------- dashboard history
 
 def history_point(samples: List[Sample]) -> Dict[str, Dict]:
@@ -296,4 +344,9 @@ def history_point(samples: List[Sample]) -> Dict[str, Dict]:
         k: {"reports": v["reports"], "workers": v["workers"]}
         for k, v in summarize_train(samples).items()
     }
-    return {"serve": serve, "data": data, "train": train}
+    llm = {
+        k: {"tokens": v["generated_tokens"], "queue": v["queue_depth"],
+            "running": v["running"]}
+        for k, v in summarize_llm(samples).items()
+    }
+    return {"serve": serve, "data": data, "train": train, "llm": llm}

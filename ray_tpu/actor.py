@@ -20,6 +20,17 @@ from ray_tpu._private.ray_option_utils import (
 )
 
 
+def _normalize_num_returns(num_returns):
+    if num_returns == "streaming":
+        raise ValueError(
+            "num_returns='streaming' (refs delivered as produced) is not "
+            "implemented; use num_returns='dynamic' — refs materialize "
+            "when the method completes")
+    if num_returns == "dynamic":
+        return -1
+    return num_returns
+
+
 def method(**options):
     """Per-method options decorator (reference: ray.method; num_returns)."""
 
@@ -32,19 +43,11 @@ def method(**options):
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
-        if num_returns in ("dynamic", "streaming"):
-            raise ValueError(
-                "num_returns='dynamic' is not supported for actor methods "
-                "yet; plain tasks support it")
         self._handle = handle
         self._name = name
-        self._num_returns = num_returns
+        self._num_returns = _normalize_num_returns(num_returns)
 
     def options(self, num_returns: Optional[int] = None) -> "ActorMethod":
-        if num_returns in ("dynamic", "streaming"):
-            raise ValueError(
-                "num_returns='dynamic' is not supported for actor methods "
-                "yet; plain tasks support it")
         return ActorMethod(self._handle, self._name,
                            num_returns if num_returns is not None else self._num_returns)
 
@@ -55,6 +58,14 @@ class ActorMethod:
             num_returns=self._num_returns,
             max_task_retries=self._handle._max_task_retries,
         )
+        if self._num_returns == -1:
+            # dynamic generator method (reference: num_returns="dynamic" on
+            # actor methods): the executor drains the generator via the same
+            # _pack_dynamic_returns path tasks use; refs materialize when
+            # the method completes
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(refs[0])
         if self._num_returns == 1:
             return refs[0]
         return refs

@@ -28,6 +28,10 @@ aiohttp app serving
                               (ray_tpu_data_* series)
     GET /api/train          — per-experiment Train view
                               (ray_tpu_train_* series)
+    GET /api/llm            — per-engine LLM inference view: TTFT/ITL
+                              percentiles, tokens/s, decode-batch occupancy,
+                              KV-page utilization, preemptions, queue depth
+                              (ray_tpu_llm_* series)
     GET /api/hangs          — suspected-hung tasks (watchdog-flagged rows
                               still running, with the stack attached at
                               flag time)
@@ -148,7 +152,7 @@ function rate(vals, interval) {
 async function load() {
   try {
     const [nodes, metrics, actors, jobs, status, tasks, summary, history,
-           serveV, dataV, trainV, hangs] =
+           serveV, dataV, trainV, llmV, hangs] =
       await Promise.all([
         fetch('/api/nodes').then(r => r.json()),
         fetch('/api/node_metrics').then(r => r.json()),
@@ -161,6 +165,7 @@ async function load() {
         fetch('/api/serve').then(r => r.json()),
         fetch('/api/data').then(r => r.json()),
         fetch('/api/train').then(r => r.json()),
+        fetch('/api/llm').then(r => r.json()),
         fetch('/api/hangs').then(r => r.json()),
       ]);
     let html = '<h2>Nodes</h2><table><tr><th>node</th><th>name</th>' +
@@ -250,6 +255,28 @@ async function load() {
           `${(p.buffered_bytes / 1048576).toFixed(1)} MiB ` +
           (p.backpressure ? '<b style="color:#b00">BACKPRESSURED</b>'
                           : '<span class="alive">flowing</span>') + '</p>';
+    }
+    const lengines = Object.entries(llmV || {});
+    if (lengines.length) {
+      html += '<h2>LLM</h2><table><tr><th>engine</th><th>requests</th>' +
+        '<th>tokens</th><th>tok/s</th><th>ttft p50 ms</th>' +
+        '<th>itl p50 ms</th><th>batch</th><th>kv util</th>' +
+        '<th>preempt</th><th>queue</th><th>tok/s over time</th>' +
+        '<th>queue over time</th></tr>';
+      for (const [name, d] of lengines.sort()) {
+        const series = k => samples.map(s => ((s.llm || {})[name] || {})[k]);
+        html += `<tr><td>${esc(name)}</td><td>${d.requests}</td>` +
+          `<td>${d.generated_tokens}</td>` +
+          `<td>${d.tokens_per_second.toFixed(1)}</td>` +
+          `<td>${(d.ttft_p50_s * 1e3).toFixed(2)}</td>` +
+          `<td>${(d.itl_p50_s * 1e3).toFixed(2)}</td>` +
+          `<td>${d.decode_batch_mean.toFixed(1)}</td>` +
+          `<td>${bar(d.kv_page_utilization)}</td>` +
+          `<td>${d.preemptions}</td><td>${d.queue_depth}</td>` +
+          `<td>${spark(rate(series('tokens'), ivl), null, '#06c')}</td>` +
+          `<td>${spark(series('queue'), null, '#b8860b')}</td></tr>`;
+      }
+      html += '</table>';
     }
     const texps = Object.entries(trainV || {});
     if (texps.length) {
@@ -485,6 +512,11 @@ class Dashboard:
 
             return mv.summarize_train(_lib_samples())
 
+        def llm_view():
+            from ray_tpu._private import metrics_view as mv
+
+            return mv.summarize_llm(_lib_samples())
+
         def actors():
             out = []
             for a in self._call("get_all_actor_info"):
@@ -641,6 +673,7 @@ class Dashboard:
         app.router.add_get("/api/serve", offload(serve_view))
         app.router.add_get("/api/data", offload(data_view))
         app.router.add_get("/api/train", offload(train_view))
+        app.router.add_get("/api/llm", offload(llm_view))
         app.router.add_get("/api/logs", offload(logs))
         app.router.add_get("/api/log", offload(log_tail))
         runner = web.AppRunner(app, access_log=None)

@@ -1,0 +1,42 @@
+"""ray_tpu.llm — continuous-batching LLM inference on the actor runtime.
+
+The serving counterpart of `models/` + `serve/`: a paged KV cache
+(`kv_cache.py`), an iteration-level batching scheduler (`scheduler.py`), a
+cache-aware model runner (`model_runner.py`), the `InferenceEngine` actor
+driving them (`engine.py`), and the Serve wrapper exposing an engine fleet
+with streaming + multiplexed adapters (`deployment.py`).
+
+Quick start::
+
+    from ray_tpu import serve
+    from ray_tpu.llm import llm_deployment
+
+    handle = serve.run(llm_deployment(), name="llm", route_prefix="/llm")
+    stream = handle.remote({"prompt": "hello", "max_tokens": 16}).result(60)
+    for event in stream:
+        ...                      # {"token": id, "text": piece} per token
+
+Observability: `ray_tpu summary llm`, dashboard ``GET /api/llm``, and
+`util.state.summarize_llm()` fold the ray_tpu_llm_* series (TTFT/ITL
+percentiles, tokens/s, KV-page utilization, preemptions, queue depth).
+"""
+
+from __future__ import annotations
+
+from ray_tpu.llm.deployment import LLMServer, llm_deployment
+from ray_tpu.llm.engine import (
+    EngineCore,
+    InferenceEngine,
+    decode_tokens,
+    encode_text,
+)
+from ray_tpu.llm.kv_cache import CacheConfig, CacheExhausted, PagedKVCache
+from ray_tpu.llm.model_runner import GPT2Runner
+from ray_tpu.llm.scheduler import Request, SamplingParams, Scheduler
+
+__all__ = [
+    "CacheConfig", "CacheExhausted", "PagedKVCache",
+    "GPT2Runner", "Request", "SamplingParams", "Scheduler",
+    "EngineCore", "InferenceEngine", "encode_text", "decode_tokens",
+    "LLMServer", "llm_deployment",
+]

@@ -1,0 +1,84 @@
+"""LLM-engine metrics (exported as ray_tpu_llm_* on every node's /metrics
+scrape; reference: vLLM's engine stats — TTFT/ITL histograms, tokens/s,
+KV-cache utilization, preemptions — folded through the same
+push->scrape->view pipeline the Serve/Data/Train series ride, PR 1-3).
+
+One lazily-built singleton set per process; the ``engine`` label keys every
+series, so several engine actors on one node stay distinguishable and the
+view layer sums/maxes them per engine name.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from ray_tpu._private import metrics as M
+
+# TTFT spans a sub-ms cache hit to a multi-second cold prefill.
+TTFT_BOUNDARIES = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+    0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+# Inter-token latency is one decode step: tighter bottom end.
+ITL_BOUNDARIES = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0,
+)
+DECODE_BATCH_BOUNDARIES = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+
+_lock = threading.Lock()
+_metrics: Dict[str, M.Metric] = {}
+
+
+def llm_metrics() -> Dict[str, M.Metric]:
+    """The process-local LLM metric set (idempotent; re-instantiation by
+    name adopts existing storage)."""
+    global _metrics
+    if not _metrics:
+        with _lock:
+            if not _metrics:
+                _metrics = {
+                    "requests": M.Counter(
+                        "llm_requests_total",
+                        "generation requests submitted, per engine"),
+                    "prompt_tokens": M.Counter(
+                        "llm_prompt_tokens_total",
+                        "prompt tokens received, per engine"),
+                    "tokens": M.Counter(
+                        "llm_tokens_generated_total",
+                        "tokens generated (decode output), per engine"),
+                    "ttft": M.Histogram(
+                        "llm_ttft_seconds",
+                        "time from submit to first generated token, "
+                        "per engine",
+                        boundaries=TTFT_BOUNDARIES),
+                    "itl": M.Histogram(
+                        "llm_inter_token_seconds",
+                        "latency between consecutive tokens of one "
+                        "request, per engine",
+                        boundaries=ITL_BOUNDARIES),
+                    "decode_batch": M.Histogram(
+                        "llm_decode_batch_size",
+                        "sequences advanced per decode step (continuous "
+                        "batching occupancy), per engine",
+                        boundaries=DECODE_BATCH_BOUNDARIES),
+                    "kv_util": M.Gauge(
+                        "llm_kv_page_utilization",
+                        "fraction of KV-cache pages in use, per engine"),
+                    "preemptions": M.Counter(
+                        "llm_preemptions_total",
+                        "requests evicted for recompute-on-resume on page "
+                        "exhaustion, per engine"),
+                    "queue_depth": M.Gauge(
+                        "llm_queue_depth",
+                        "requests waiting for admission, per engine"),
+                    "running": M.Gauge(
+                        "llm_running_requests",
+                        "requests in the running decode batch, per engine"),
+                    "tokens_per_second": M.Gauge(
+                        "llm_tokens_per_second",
+                        "generation throughput since the first token of "
+                        "the current run, per engine"),
+                }
+    return _metrics

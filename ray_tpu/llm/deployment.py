@@ -1,0 +1,152 @@
+"""Serve integration: expose an InferenceEngine fleet as a deployment.
+
+``llm_deployment(...)`` returns a Serve Application whose replicas each own
+one `InferenceEngine` actor (the engine-per-replica fleet shape of the
+Podracer architectures, arXiv 2104.06272): Serve's pow-2 router spreads
+requests over replicas, `@serve.multiplexed` adapter loading gives the
+router affinity to replicas that already hold an adapter, token streams ride
+the serve streaming path (replica generator -> ResponseStream -> SSE at the
+proxy), and the engine's admission queue feeds the queue-depth autoscaler
+through the replica's ``__serve_queue_len__`` protocol hook.
+
+Request body (dict over the handle, JSON over HTTP)::
+
+    {"prompt": "text"              # or "prompt_ids": [ints]
+     "max_tokens": 32, "temperature": 0.0, "top_k": 0, "seed": 0,
+     "stream": true}               # false -> single buffered response
+
+Streaming responses yield ``{"token": id, "text": piece}`` per token and a
+final ``{"done": true, "request_id": ..., "text": full, ...}`` event.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional, Union
+
+import ray_tpu
+from ray_tpu import serve
+
+logger = logging.getLogger(__name__)
+
+
+class LLMServer:
+    """The deployment class: thin async facade over one engine actor."""
+
+    def __init__(self, engine_kwargs: Optional[dict] = None,
+                 stream_by_default: bool = True):
+        from ray_tpu.llm.engine import InferenceEngine
+
+        kwargs = dict(engine_kwargs or {})
+        kwargs.setdefault("engine_name", "serve-llm")
+        self._engine = InferenceEngine.options(num_cpus=0).remote(**kwargs)
+        self._stream_by_default = stream_by_default
+        # block until the engine actor is alive so the replica only reports
+        # ready once it can actually serve
+        ray_tpu.get(self._engine.ping.remote(), timeout=120)
+
+    # ------------------------------------------------------- multiplexing
+    @serve.multiplexed(max_num_models_per_replica=4)
+    async def get_adapter(self, adapter_id: str):
+        """Adapter loader: registered with the engine once per replica and
+        LRU-cached by the multiplex wrapper, so the router steers repeat
+        requests for an adapter to a replica that already holds it."""
+        await self._engine.load_adapter.remote(adapter_id)
+        return adapter_id
+
+    # ------------------------------------------------------------ request
+    async def __call__(self, body: Union[dict, str, bytes, None]):
+        if isinstance(body, (bytes, bytearray)):
+            body = body.decode()
+        if isinstance(body, str):
+            body = {"prompt": body}
+        if not isinstance(body, dict):
+            raise ValueError(
+                "llm request must be a JSON object or a prompt string")
+        prompt = body.get("prompt_ids") or body.get("prompt")
+        if prompt is None:
+            raise ValueError("missing 'prompt' or 'prompt_ids'")
+        params = {
+            k: body[k]
+            for k in ("max_tokens", "temperature", "top_k", "seed", "stop")
+            if k in body
+        }
+        if "stop" in params:
+            params["stop"] = tuple(params["stop"])
+        adapter = serve.get_multiplexed_model_id()
+        if adapter:
+            await self.get_adapter(adapter)
+            params["adapter"] = adapter
+        rid = await self._engine.submit.remote(prompt, params)
+        stream = body.get("stream", self._stream_by_default)
+        if stream:
+            return self._token_stream(rid)
+        return await self._drain(rid)
+
+    async def _token_stream(self, rid: str):
+        """Async generator: the replica's streaming path drains it into a
+        pullable stream; each engine long-poll batch fans out as per-token
+        events."""
+        from ray_tpu.llm.engine import decode_tokens
+
+        cursor = 0
+        while True:
+            out = await self._engine.next_output.remote(rid, cursor, 20.0)
+            for t in out["tokens"]:
+                yield {"token": int(t), "text": decode_tokens([t])}
+            cursor += len(out["tokens"])
+            if out["finished"]:
+                if out["error"]:
+                    raise RuntimeError(out["error"])
+                result = await self._engine.result.remote(rid)
+                yield {"done": True, "request_id": rid,
+                       "text": result["text"],
+                       "num_tokens": len(result["tokens"]),
+                       "finish_reason": result["finish_reason"]}
+                return
+
+    async def _drain(self, rid: str) -> Dict[str, Any]:
+        cursor = 0
+        while True:
+            out = await self._engine.next_output.remote(rid, cursor, 20.0)
+            cursor += len(out["tokens"])
+            if out["finished"]:
+                if out["error"]:
+                    raise RuntimeError(out["error"])
+                return await self._engine.result.remote(rid)
+
+    # ----------------------------------------------------------- plumbing
+    def __serve_queue_len__(self) -> int:
+        """Queue-depth signal for the serve autoscaler: requests parked in
+        the engine behind the currently-running batch (the replica adds
+        this to its in-flight count in ``stats()``)."""
+        try:
+            st = ray_tpu.get(self._engine.stats.remote(), timeout=2)
+            return int(st["waiting"] + st["running"])
+        except Exception:
+            return 0
+
+    def engine_stats(self) -> Dict[str, Any]:
+        return ray_tpu.get(self._engine.stats.remote(), timeout=10)
+
+    def check_health(self) -> None:
+        ray_tpu.get(self._engine.ping.remote(), timeout=5)
+
+
+def llm_deployment(engine_kwargs: Optional[dict] = None, *,
+                   name: str = "LLM", num_replicas: int = 1,
+                   max_ongoing_requests: int = 64,
+                   autoscaling_config=None,
+                   stream_by_default: bool = True) -> "serve.Application":
+    """Build a Serve Application serving an LLM engine fleet::
+
+        app = llm_deployment(engine_kwargs={"num_pages": 64})
+        handle = serve.run(app, name="llm", route_prefix="/llm")
+        stream = handle.remote({"prompt_ids": [1, 2, 3]}).result(60)
+        for event in stream: ...
+    """
+    dep = serve.deployment(
+        LLMServer, name=name, num_replicas=num_replicas,
+        max_ongoing_requests=max_ongoing_requests,
+        autoscaling_config=autoscaling_config)
+    return dep.bind(engine_kwargs, stream_by_default)

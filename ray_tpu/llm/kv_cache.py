@@ -1,0 +1,244 @@
+"""Paged KV cache: fixed-size pages + per-sequence page tables.
+
+Reference: vLLM's PagedAttention block manager (block tables of fixed-size
+blocks, allocated per sequence, freed on completion/preemption), condensed.
+The cache preallocates one K and one V array per transformer layer shaped
+``[num_pages, page_size, num_heads, head_dim]``; a sequence owns an ordered
+list of page ids, and token position ``p`` of that sequence lives at
+``(pages[p // page_size], p % page_size)`` in EVERY layer — one page id
+indexes all layers, so alloc/free accounting is per sequence, not per layer.
+
+Backends: ``jax`` keeps the arrays as device buffers (scatter via
+``.at[].set``) — the layout the TPU serving path wants, HBM-resident and
+XLA-updatable; ``numpy`` is the pure-host fallback the CPU engine and tier-1
+tests run on (`JAX_PLATFORMS=cpu` or no jax at all).  ``auto`` picks jax
+when importable, else numpy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class CacheExhausted(RuntimeError):
+    """No free pages for the requested reservation (caller may preempt)."""
+
+
+@dataclass(frozen=True)
+class CacheConfig:
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    num_pages: int = 64
+    page_size: int = 16
+    backend: str = "numpy"  # "numpy" | "jax" | "auto"
+
+    def __post_init__(self):
+        if self.num_pages <= 0 or self.page_size <= 0:
+            raise ValueError("num_pages and page_size must be > 0")
+        if self.num_layers <= 0 or self.num_heads <= 0 or self.head_dim <= 0:
+            raise ValueError("layers/heads/head_dim must be > 0")
+
+
+class _SeqEntry:
+    __slots__ = ("pages", "length")
+
+    def __init__(self):
+        self.pages: List[int] = []
+        self.length = 0  # committed tokens
+
+
+def _resolve_backend(backend: str) -> str:
+    if backend == "auto":
+        try:
+            import jax  # noqa: F401
+
+            return "jax"
+        except Exception:
+            return "numpy"
+    if backend not in ("numpy", "jax"):
+        raise ValueError(f"unknown cache backend {backend!r}")
+    return backend
+
+
+class PagedKVCache:
+    """Not thread-safe: the engine serializes all cache access under its
+    lock (scheduler planning) or confines it to the step thread (runner
+    reads/writes)."""
+
+    def __init__(self, config: CacheConfig):
+        self.config = config
+        self.backend = _resolve_backend(config.backend)
+        shape = (config.num_pages, config.page_size,
+                 config.num_heads, config.head_dim)
+        if self.backend == "jax":
+            import jax.numpy as jnp
+
+            self._jnp = jnp
+            self._k = [jnp.zeros(shape, jnp.float32)
+                       for _ in range(config.num_layers)]
+            self._v = [jnp.zeros(shape, jnp.float32)
+                       for _ in range(config.num_layers)]
+        else:
+            self._k = [np.zeros(shape, np.float32)
+                       for _ in range(config.num_layers)]
+            self._v = [np.zeros(shape, np.float32)
+                       for _ in range(config.num_layers)]
+        # LIFO free list: recently-freed pages are re-used first (warm)
+        self._free: List[int] = list(range(config.num_pages - 1, -1, -1))
+        self._seqs: Dict[str, _SeqEntry] = {}
+        self.peak_pages_used = 0
+
+    # ------------------------------------------------------- accounting
+    @property
+    def num_pages(self) -> int:
+        return self.config.num_pages
+
+    @property
+    def page_size(self) -> int:
+        return self.config.page_size
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.config.num_pages - len(self._free)
+
+    def utilization(self) -> float:
+        return self.used_pages / self.config.num_pages
+
+    def pages_for(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.config.page_size)  # ceil div
+
+    def has_seq(self, seq_id: str) -> bool:
+        return seq_id in self._seqs
+
+    def seq_len(self, seq_id: str) -> int:
+        return self._seqs[seq_id].length
+
+    def pages_of(self, seq_id: str) -> List[int]:
+        return list(self._seqs[seq_id].pages)
+
+    def check_leaks(self) -> None:
+        """Invariant: every page is either free or owned by exactly one
+        sequence (the leak-accounting check tests assert after churn)."""
+        owned = [p for e in self._seqs.values() for p in e.pages]
+        if len(owned) != len(set(owned)):
+            raise AssertionError("page owned by more than one sequence")
+        if len(owned) + len(self._free) != self.config.num_pages:
+            raise AssertionError(
+                f"page leak: {len(owned)} owned + {len(self._free)} free "
+                f"!= {self.config.num_pages} total")
+        if set(owned) & set(self._free):
+            raise AssertionError("page simultaneously owned and free")
+
+    # ------------------------------------------------------- allocation
+    def can_reserve(self, seq_id: str, new_len: int) -> bool:
+        have = len(self._seqs[seq_id].pages) if seq_id in self._seqs else 0
+        return self.pages_for(new_len) - have <= len(self._free)
+
+    def reserve(self, seq_id: str, new_len: int) -> None:
+        """Grow ``seq_id``'s page table to cover ``new_len`` tokens.
+        All-or-nothing: raises CacheExhausted without allocating anything
+        when the free pool can't cover the growth."""
+        entry = self._seqs.get(seq_id)
+        if entry is None:
+            entry = self._seqs.setdefault(seq_id, _SeqEntry())
+        need = self.pages_for(new_len) - len(entry.pages)
+        if need <= 0:
+            return
+        if need > len(self._free):
+            if not entry.pages and entry.length == 0:
+                # never-written fresh entry: don't leave an empty table
+                self._seqs.pop(seq_id, None)
+            raise CacheExhausted(
+                f"need {need} pages for seq {seq_id!r} "
+                f"(len {new_len}), {len(self._free)} free")
+        for _ in range(need):
+            entry.pages.append(self._free.pop())
+        self.peak_pages_used = max(self.peak_pages_used, self.used_pages)
+
+    def free(self, seq_id: str) -> int:
+        """Release every page of ``seq_id`` (completion, abort, preemption
+        with recompute-on-resume).  Returns the number of pages released."""
+        entry = self._seqs.pop(seq_id, None)
+        if entry is None:
+            return 0
+        self._free.extend(reversed(entry.pages))
+        return len(entry.pages)
+
+    # ------------------------------------------------------------- data
+    def write(self, seq_id: str, layer: int, start: int, k, v) -> None:
+        """Scatter ``k``/``v`` of shape [T, heads, head_dim] into the pages
+        of ``seq_id`` at token positions start..start+T-1 (pages must be
+        reserved first)."""
+        entry = self._seqs[seq_id]
+        k = np.asarray(k, np.float32)
+        v = np.asarray(v, np.float32)
+        T = k.shape[0]
+        ps = self.config.page_size
+        if self.pages_for(start + T) > len(entry.pages):
+            raise IndexError(
+                f"write past reservation for seq {seq_id!r}: "
+                f"pos {start + T} > {len(entry.pages)} pages")
+        i = 0
+        while i < T:
+            pos = start + i
+            page = entry.pages[pos // ps]
+            off = pos % ps
+            n = min(ps - off, T - i)
+            if self.backend == "jax":
+                self._k[layer] = self._k[layer].at[page, off:off + n].set(
+                    self._jnp.asarray(k[i:i + n]))
+                self._v[layer] = self._v[layer].at[page, off:off + n].set(
+                    self._jnp.asarray(v[i:i + n]))
+            else:
+                self._k[layer][page, off:off + n] = k[i:i + n]
+                self._v[layer][page, off:off + n] = v[i:i + n]
+            i += n
+
+    def commit(self, seq_id: str, new_len: int) -> None:
+        """Mark tokens up to ``new_len`` as valid (call after writing all
+        layers, so a mid-write failure never exposes torn state)."""
+        entry = self._seqs[seq_id]
+        if self.pages_for(new_len) > len(entry.pages):
+            raise IndexError("commit past reservation")
+        entry.length = max(entry.length, new_len)
+
+    def gather(self, seq_id: str, layer: int,
+               length: Optional[int] = None) -> np.ndarray:
+        """Contiguous [length, heads, head_dim] K view of ``seq_id``'s cache
+        (use ``gather_kv`` for both).  Host numpy either way: the CPU
+        runner consumes host arrays; a TPU paged-attention kernel would read
+        the device pages in place instead."""
+        return self._gather_one(self._k, seq_id, layer, length)
+
+    def gather_kv(self, seq_id: str, layer: int,
+                  length: Optional[int] = None):
+        return (self._gather_one(self._k, seq_id, layer, length),
+                self._gather_one(self._v, seq_id, layer, length))
+
+    def _gather_one(self, store, seq_id: str, layer: int,
+                    length: Optional[int]) -> np.ndarray:
+        entry = self._seqs[seq_id]
+        n = entry.length if length is None else length
+        if n > entry.length:
+            raise IndexError(f"gather {n} > committed {entry.length}")
+        ps = self.config.page_size
+        arr = store[layer]
+        if self.backend == "jax":
+            arr = np.asarray(arr)
+        full = n // ps
+        parts = [arr[p] for p in entry.pages[:full]]
+        rem = n - full * ps
+        if rem:
+            parts.append(arr[entry.pages[full], :rem])
+        if not parts:
+            return np.zeros((0, self.config.num_heads, self.config.head_dim),
+                            np.float32)
+        return np.concatenate(parts, axis=0)

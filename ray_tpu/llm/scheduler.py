@@ -1,0 +1,245 @@
+"""Iteration-level (continuous) batching scheduler.
+
+Reference: Orca's iteration-level scheduling (the idea vLLM's scheduler
+implements): the unit of scheduling is ONE model step, not one request.
+Between decode steps the scheduler admits waiting requests FCFS under a
+per-step token budget, so new arrivals join the running batch at the next
+iteration instead of waiting for the batch to drain; when the paged cache
+runs out, the newest running request is preempted — its pages are freed and
+it re-enters the waiting queue for recompute-on-resume (prefill over
+prompt + tokens generated so far, which reproduces identical state).
+
+Structuring prefill and decode as distinct stages that one step can mix
+follows the MPMD-stage decomposition (arXiv 2412.14374); the scheduler is
+deliberately free of model math so the engine can later pin the two stages
+to different meshes.
+"""
+
+from __future__ import annotations
+
+import bisect
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+from ray_tpu.llm.kv_cache import CacheExhausted, PagedKVCache
+
+# request lifecycle
+WAITING = "WAITING"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+ABORTED = "ABORTED"
+
+_arrival_counter = itertools.count()
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    max_tokens: int = 16
+    temperature: float = 0.0   # 0 -> greedy
+    top_k: int = 0             # 0 -> full vocab
+    seed: int = 0
+    stop: Tuple[int, ...] = ()
+    adapter: str = ""          # multiplexed adapter id ("" = base model)
+
+    def __post_init__(self):
+        if self.max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        if self.temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        if self.top_k < 0:
+            raise ValueError("top_k must be >= 0")
+
+
+class Request:
+    """One generation request; ``rid`` doubles as the cache seq id."""
+
+    def __init__(self, rid: str, prompt: Sequence[int],
+                 params: SamplingParams):
+        self.rid = rid
+        self.prompt = list(prompt)
+        self.params = params
+        self.outputs: List[int] = []
+        # tokens already resident in the KV cache; reset to 0 on preemption
+        # (recompute-on-resume)
+        self.num_computed = 0
+        self.state = WAITING
+        self.arrival = next(_arrival_counter)
+        self.submitted_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+        self.last_token_at: Optional[float] = None
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[str] = None
+        self.preemptions = 0
+
+    @property
+    def all_tokens(self) -> List[int]:
+        return self.prompt + self.outputs
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt) + len(self.outputs)
+
+    def __repr__(self):
+        return (f"Request({self.rid}, {self.state}, "
+                f"prompt={len(self.prompt)}, out={len(self.outputs)})")
+
+
+@dataclass
+class StepPlan:
+    """What one engine step executes.  ``prefills``: (request, tokens,
+    start_position) chunks to run through the prefill path; ``decodes``:
+    running requests advancing one token; ``preempted``: requests evicted
+    this step (already moved back to waiting); ``failed``: requests the
+    scheduler could never place."""
+
+    prefills: List[Tuple[Request, List[int], int]] = field(
+        default_factory=list)
+    decodes: List[Request] = field(default_factory=list)
+    preempted: List[Request] = field(default_factory=list)
+    failed: List[Request] = field(default_factory=list)
+
+    def __bool__(self):
+        return bool(self.prefills or self.decodes or self.preempted
+                    or self.failed)
+
+
+class Scheduler:
+    def __init__(self, cache: PagedKVCache, *,
+                 max_batch_tokens: int = 128, max_running: int = 64):
+        if max_batch_tokens < 1:
+            raise ValueError("max_batch_tokens must be >= 1")
+        self.cache = cache
+        self.max_batch_tokens = max_batch_tokens
+        self.max_running = max_running
+        self.waiting: List[Request] = []   # kept sorted by arrival (FCFS)
+        self.running: List[Request] = []   # kept in arrival order
+        self.preemptions = 0
+
+    # ------------------------------------------------------------ intake
+    def add(self, req: Request) -> None:
+        bisect.insort(self.waiting, req, key=lambda r: r.arrival)
+
+    def remove(self, req: Request) -> None:
+        """Drop a request from whichever queue holds it; frees its pages."""
+        if req in self.waiting:
+            self.waiting.remove(req)
+        if req in self.running:
+            self.running.remove(req)
+        self.cache.free(req.rid)
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self.running)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # -------------------------------------------------------------- plan
+    def plan(self) -> StepPlan:
+        """Build one iteration: decode every running sequence (preempting
+        newest-first on page exhaustion), then admit waiting requests FCFS
+        into the leftover token budget."""
+        out = StepPlan()
+        budget = self.max_batch_tokens
+
+        # 1. decode pass — arrival order so older requests keep priority
+        for req in list(self.running):
+            if req.state is not RUNNING:
+                continue  # preempted by an earlier iteration of this loop
+            if budget <= 0:
+                break
+            # a decode step writes K/V at position total_len-1, growing the
+            # committed cache length to total_len
+            if self._reserve_with_preemption(req, req.total_len, out):
+                out.decodes.append(req)
+                budget -= 1
+
+        # 2. FCFS admission between decode steps
+        while self.waiting and budget > 0 \
+                and len(self.running) < self.max_running:
+            req = self.waiting[0]
+            tokens = req.all_tokens[req.num_computed:]
+            if len(tokens) > budget:
+                # head-of-line stays (strict FCFS): a later shorter request
+                # must not starve it
+                break
+            need_total = self.cache.pages_for(req.total_len + 1)
+            if need_total > self.cache.num_pages:
+                self._fail(req, out,
+                           f"request needs {need_total} pages; cache has "
+                           f"{self.cache.num_pages}")
+                continue
+            try:
+                self.cache.reserve(req.rid, req.total_len)
+            except CacheExhausted:
+                if self.cache.used_pages == 0 and not self.running:
+                    # whole cache is free and it still doesn't fit — it
+                    # never will
+                    self._fail(req, out, "request does not fit in an "
+                               "empty KV cache")
+                    continue
+                break
+            self.waiting.pop(0)
+            req.state = RUNNING
+            self.running.append(req)
+            out.prefills.append((req, tokens, req.num_computed))
+            budget -= len(tokens)
+        return out
+
+    def _fail(self, req: Request, out: StepPlan, reason: str) -> None:
+        self.waiting.remove(req)
+        self.cache.free(req.rid)
+        req.state = FAILED
+        req.error = reason
+        req.finish_reason = "error"
+        out.failed.append(req)
+
+    def _reserve_with_preemption(self, req: Request, new_len: int,
+                                 out: StepPlan) -> bool:
+        """Reserve pages for ``req`` up to ``new_len``, preempting the
+        newest-arrival running request (possibly ``req`` itself, last) until
+        the reservation fits.  Returns False when ``req`` was the victim."""
+        while True:
+            try:
+                self.cache.reserve(req.rid, new_len)
+                return True
+            except CacheExhausted:
+                victims = [r for r in self.running
+                           if r.state is RUNNING and r is not req]
+                victim = max(victims, key=lambda r: r.arrival) \
+                    if victims else req
+                self._preempt(victim, out)
+                if victim is req:
+                    return False
+
+    def _preempt(self, req: Request, out: StepPlan) -> None:
+        """Evict: free pages, requeue for recompute-on-resume.  The request
+        keeps its generated tokens; on re-admission the prefill covers
+        prompt + outputs so the resumed state is bit-identical."""
+        self.cache.free(req.rid)
+        self.running.remove(req)
+        req.num_computed = 0
+        req.state = WAITING
+        req.preemptions += 1
+        self.preemptions += 1
+        self.add(req)
+        out.preempted.append(req)
+
+    # --------------------------------------------------------- completion
+    def finish(self, req: Request, reason: str) -> None:
+        """Mark finished and release pages (called by the engine when
+        max_tokens or a stop token lands)."""
+        if req in self.running:
+            self.running.remove(req)
+        if req in self.waiting:
+            self.waiting.remove(req)
+        self.cache.free(req.rid)
+        req.state = FINISHED
+        req.finish_reason = reason

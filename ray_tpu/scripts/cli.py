@@ -15,7 +15,7 @@ Usage:
     python -m ray_tpu job list/status/logs/stop [ID]
     python -m ray_tpu timeline [--output PATH]
     python -m ray_tpu profile [--name TASK]
-    python -m ray_tpu summary tasks|serve|data|train|hangs
+    python -m ray_tpu summary tasks|serve|data|train|llm|hangs
     python -m ray_tpu stack [TASK_ID] [--node NODE_ID]
     python -m ray_tpu logs FILE --follow
 """
@@ -228,9 +228,27 @@ def _cmd_summary(args) -> int:
         _print_data_summary(state.summarize_data())
     elif args.what == "train":
         _print_train_summary(state.summarize_train())
+    elif args.what == "llm":
+        _print_llm_summary(state.summarize_llm())
     elif args.what == "hangs":
         _print_hangs_summary(state.summarize_hangs())
     return 0
+
+
+def _print_llm_summary(summary: dict) -> None:
+    if not summary:
+        print("no llm metrics recorded yet (is an engine serving?)")
+        return
+    print(f"{'engine':24} {'reqs':>6} {'tokens':>8} {'tok/s':>8} "
+          f"{'ttft p50 ms':>12} {'ttft p95 ms':>12} {'itl p50 ms':>11} "
+          f"{'batch':>6} {'kv%':>5} {'preempt':>8} {'queue':>6}")
+    for name, d in sorted(summary.items()):
+        print(f"{name:24} {d['requests']:>6g} {d['generated_tokens']:>8g} "
+              f"{d['tokens_per_second']:>8.1f} "
+              f"{d['ttft_p50_s']*1e3:>12.3f} {d['ttft_p95_s']*1e3:>12.3f} "
+              f"{d['itl_p50_s']*1e3:>11.3f} {d['decode_batch_mean']:>6.1f} "
+              f"{d['kv_page_utilization']*100:>5.1f} "
+              f"{d['preemptions']:>8g} {d['queue_depth']:>6g}")
 
 
 def _print_hangs_summary(hangs: list) -> None:
@@ -530,9 +548,10 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("summary",
                        help="summarize cluster entities "
-                            "(tasks, serve, data, train, hangs)")
+                            "(tasks, serve, data, train, llm, hangs)")
     p.add_argument("what",
-                   choices=["tasks", "serve", "data", "train", "hangs"],
+                   choices=["tasks", "serve", "data", "train", "llm",
+                            "hangs"],
                    help="entity kind to summarize")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=_cmd_summary)

@@ -59,6 +59,10 @@ def serve_metrics() -> Dict[str, M.Metric]:
                         "serve_autoscale_decisions_total",
                         "committed autoscaler scale decisions, per "
                         "app/deployment/direction"),
+                    "streams": M.Counter(
+                        "serve_streams_total",
+                        "streaming (generator) responses started, per "
+                        "app/deployment"),
                     "ingress_requests": M.Counter(
                         "serve_ingress_requests_total",
                         "proxy ingress requests, per protocol/status"),

@@ -127,6 +127,12 @@ class ProxyActor:
             self._observe_ingress("grpc", "error", start)
             await context.abort(grpc.StatusCode.INTERNAL,
                                 f"{type(e).__name__}: {e}")
+        from ray_tpu.serve._streaming import ResponseStream
+
+        if isinstance(out, ResponseStream):
+            # unary gRPC has no chunk framing: drain and reply once
+            # (streaming ingress is the HTTP/SSE path)
+            out = await loop.run_in_executor(None, lambda: list(out))
         self._observe_ingress("grpc", "ok", start)
         if isinstance(out, bytes):
             return out
@@ -161,12 +167,68 @@ class ProxyActor:
         except Exception as e:
             self._observe_ingress("http", "500", start)
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        from ray_tpu.serve._streaming import ResponseStream
+
+        if isinstance(out, ResponseStream):
+            return await self._stream_response(request, out, start)
         self._observe_ingress("http", "200", start)
         if isinstance(out, (dict, list)):
             return web.json_response(out)
         if isinstance(out, bytes):
             return web.Response(body=out)
         return web.Response(text=str(out))
+
+    async def _stream_response(self, request, stream, start):
+        """Generator-returning deployment over HTTP: chunked SSE — each
+        produced item is one ``data:`` event, flushed as it arrives, so
+        token streams reach the client incrementally instead of buffering
+        to completion (reference: serve's StreamingResponse proxying)."""
+        from aiohttp import web
+
+        loop = asyncio.get_event_loop()
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "X-Accel-Buffering": "no",
+        })
+        await resp.prepare(request)
+        status = "200"
+        try:
+            while True:
+                # each pull blocks on the replica long-poll: executor thread
+                items, done = await loop.run_in_executor(
+                    None, stream.next_batch, 30.0)
+                for item in items:
+                    if isinstance(item, bytes):
+                        payload = item
+                    elif isinstance(item, str):
+                        payload = item.encode()
+                    else:
+                        payload = json.dumps(item).encode()
+                    await resp.write(b"data: " + payload + b"\n\n")
+                if done:
+                    await resp.write(b"data: [DONE]\n\n")
+                    break
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away: stop the replica-side generator
+            status = "499"
+            await loop.run_in_executor(None, stream.cancel)
+            raise
+        except Exception as e:
+            status = "500"
+            try:
+                await resp.write(
+                    b"event: error\ndata: " +
+                    f"{type(e).__name__}: {e}".encode() + b"\n\n")
+            except Exception:
+                pass
+        finally:
+            self._observe_ingress("http", status, start)
+        try:
+            await resp.write_eof()
+        except Exception:
+            pass
+        return resp
 
     def _ensure_routes_listener(self):
         import threading

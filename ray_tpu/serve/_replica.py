@@ -47,6 +47,9 @@ class ServeReplica:
         self._mux_seq = 0
         self._mux_seq_lock = __import__("threading").Lock()
         _mux._set_report_hook(self._report_models)
+        # in-flight response streams (generator-returning callables):
+        # stream_id -> _StreamState, IO-loop confined
+        self._streams: Dict[str, Any] = {}
 
     def _report_models(self, model_ids):
         # Runs on the replica's IO loop (model-cache finally): the controller
@@ -108,6 +111,11 @@ class ServeReplica:
                     None, lambda: ctx.run(call, *args, **kwargs))
             if inspect.isawaitable(out):
                 out = await out
+            if inspect.isgenerator(out) or inspect.isasyncgen(out):
+                # streaming result: park the generator here, hand the
+                # caller a pull handle (see serve/_streaming.py)
+                out = self._start_stream(out)
+                m["streams"].inc(1, labels)
             return out
         except BaseException:
             failed = True
@@ -138,8 +146,125 @@ class ServeReplica:
                 kwargs[k] = await get_async(v)
         return tuple(args), kwargs
 
+    # ------------------------------------------------------- streaming
+    def _start_stream(self, gen):
+        """Register a generator result as a pullable stream; returns the
+        StreamHeader the caller unwraps into a ResponseStream."""
+        import uuid
+
+        from ray_tpu.serve._streaming import (
+            STREAM_TTL_S,
+            StreamHeader,
+            _StreamState,
+        )
+
+        # lazy sweep: done-but-never-drained streams must not accumulate
+        now = time.monotonic()
+        for sid, st in list(self._streams.items()):
+            if st.done and now - st.created > STREAM_TTL_S:
+                del self._streams[sid]
+
+        sid = uuid.uuid4().hex[:16]
+        st = _StreamState()
+        st.producer_ev = asyncio.Event()
+        self._streams[sid] = st
+        st.producer = asyncio.get_event_loop().create_task(
+            self._pump_stream(sid, st, gen))
+        return StreamHeader(sid)
+
+    async def _pump_stream(self, sid, st, gen):
+        """Drain the generator into the stream buffer.  Sync generators are
+        pulled item-by-item on executor threads (their body may block on
+        runtime calls); async generators run on the loop."""
+        from ray_tpu.serve._streaming import MAX_BUFFERED_ITEMS
+
+        import inspect as _inspect
+
+        _SENTINEL = object()
+        loop = asyncio.get_event_loop()
+        try:
+            if _inspect.isasyncgen(gen):
+                async for item in gen:
+                    await self._stream_put(st, item, MAX_BUFFERED_ITEMS)
+            else:
+                while True:
+                    item = await loop.run_in_executor(
+                        None, next, gen, _SENTINEL)
+                    if item is _SENTINEL:
+                        break
+                    await self._stream_put(st, item, MAX_BUFFERED_ITEMS)
+        except asyncio.CancelledError:
+            st.error = "stream cancelled"
+            raise
+        except BaseException as e:
+            st.error = f"{type(e).__name__}: {e}"
+        finally:
+            st.done = True
+            st.wake()
+            # cancelled/abandoned streams: the entry survives until drained
+            # or swept; st.created reset so TTL counts from completion
+            st.created = time.monotonic()
+
+    async def _stream_put(self, st, item, cap):
+        while len(st.items) - st.consumed >= cap:
+            # backpressure: wait for a consumer to advance
+            st.producer_ev.clear()
+            await st.producer_ev.wait()
+        st.items.append(item)
+        st.wake()
+
+    async def stream_next(self, stream_id: str, cursor: int,
+                          timeout_s: float = 30.0) -> Dict[str, Any]:
+        """Long-poll: items past ``cursor`` (or done/error state).  Fully
+        drained done streams are dropped from the table."""
+        st = self._streams.get(stream_id)
+        if st is None:
+            raise KeyError(f"unknown or expired stream {stream_id!r}")
+        deadline = time.monotonic() + timeout_s
+        while len(st.items) <= cursor and not st.done:
+            ev = asyncio.Event()
+            st.waiters.append(ev)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"items": [], "done": False, "error": None}
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                return {"items": [], "done": False, "error": None}
+        items = st.items[cursor:]
+        new_cursor = cursor + len(items)
+        if new_cursor > st.consumed:
+            st.consumed = new_cursor
+            if st.producer_ev is not None:
+                st.producer_ev.set()
+        done = st.done and new_cursor >= len(st.items)
+        if done:
+            self._streams.pop(stream_id, None)
+        return {"items": items, "done": done, "error": st.error}
+
+    async def stream_cancel(self, stream_id: str) -> bool:
+        st = self._streams.pop(stream_id, None)
+        if st is None:
+            return False
+        if st.producer is not None and not st.producer.done():
+            st.producer.cancel()
+        st.done = True
+        st.wake()
+        return True
+
     def stats(self) -> Dict[str, Any]:
-        return {"ongoing": self._ongoing, "total": self._total,
+        ongoing = self._ongoing
+        # deployments that queue work behind the request path (e.g. an LLM
+        # engine's admission queue) surface it through this protocol hook so
+        # the controller's queue-depth autoscaler sees the real backlog
+        extra = getattr(self._user, "__serve_queue_len__", None)
+        if extra is not None:
+            try:
+                ongoing += int(extra())
+            except Exception:
+                pass
+        return {"ongoing": ongoing, "total": self._total,
+                "streams": len(self._streams),
                 "uptime_s": time.time() - self._started_at}
 
     def ping(self) -> bool:

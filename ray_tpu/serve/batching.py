@@ -63,12 +63,27 @@ class _Batcher:
                     f"@serve.batch function returned {len(out)} results "
                     f"for {len(args)} requests")
             for f, o in zip(futs, out):
-                if not f.done():
-                    f.set_result(o)
+                _safe_resolve(f, result=o)
         except BaseException as e:
             for f in futs:
-                if not f.done():
-                    f.set_exception(e)
+                _safe_resolve(f, exception=e)
+
+
+def _safe_resolve(fut: asyncio.Future, result=None, exception=None) -> None:
+    """Resolve one co-batched caller's future without letting a cancelled
+    (or otherwise already-settled) future poison its batch-mates: an
+    unguarded ``set_result`` raising InvalidStateError inside ``_run``'s
+    result loop would divert every remaining future to the exception path,
+    failing requests whose results are already in hand."""
+    if fut.done():
+        return
+    try:
+        if exception is not None:
+            fut.set_exception(exception)
+        else:
+            fut.set_result(result)
+    except asyncio.InvalidStateError:
+        pass  # cancelled between the check and the set
 
 
 def batch(_fn=None, *, max_batch_size: int = 8,

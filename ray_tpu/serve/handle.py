@@ -259,7 +259,8 @@ class DeploymentHandle:
 
         if core.memory_store.add_ready_callback(ref.oid, release):
             release()  # already completed
-        return _TrackedResponse(ref, self, args, kwargs, retries)
+        return _TrackedResponse(ref, self, args, kwargs, retries,
+                                replica=replica)
 
 
 class _TrackedResponse(DeploymentResponse):
@@ -268,19 +269,32 @@ class _TrackedResponse(DeploymentResponse):
     cached replica set can be up to _REFRESH_PERIOD_S stale)."""
 
     def __init__(self, ref, handle: "DeploymentHandle", args, kwargs,
-                 retries: int):
+                 retries: int, replica=None):
         super().__init__(ref)
         self._handle = handle
         self._args = args
         self._kwargs = kwargs
         self._retries = retries
+        self._replica = replica
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
         try:
-            return super().result(timeout_s)
+            out = super().result(timeout_s)
         except RayActorError:
             if self._retries <= 0:
                 raise
             retry = self._handle._call(self._args, self._kwargs,
                                        self._retries - 1)
             return retry.result(timeout_s)
+        return self._unwrap_stream(out)
+
+    def _unwrap_stream(self, out):
+        """Generator-returning deployments answer with a StreamHeader: hand
+        the caller a pull-based ResponseStream bound to the SAME replica
+        that holds the generator (streams are replica-affine; a retry
+        through another replica could not resume them)."""
+        from ray_tpu.serve._streaming import ResponseStream, StreamHeader
+
+        if isinstance(out, StreamHeader) and self._replica is not None:
+            return ResponseStream(self._replica, out.stream_id)
+        return out

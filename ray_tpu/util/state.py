@@ -188,6 +188,16 @@ def summarize_train() -> Dict[str, Any]:
     return mv.summarize_train(_collect_metric_samples())
 
 
+def summarize_llm() -> Dict[str, Any]:
+    """Per-engine LLM inference view: TTFT/inter-token latency percentiles,
+    tokens/s, decode-batch occupancy, KV-page utilization, preemptions and
+    queue depth (the ray_tpu_llm_* series the continuous-batching engine
+    exports; reference: vLLM's engine stats surface)."""
+    from ray_tpu._private import metrics_view as mv
+
+    return mv.summarize_llm(_collect_metric_samples())
+
+
 def get_stacks(node_id: Optional[str] = None,
                task_id: Optional[str] = None) -> List[Dict[str, Any]]:
     """Live Python stacks across the cluster (the `ray_tpu stack` payload).

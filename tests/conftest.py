@@ -104,6 +104,13 @@ def ray_start_cluster():
     cluster.shutdown()
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy end-to-end cases excluded from the tier-1 run "
+        "(-m 'not slow')")
+
+
 def pytest_sessionfinish(session, exitstatus):
     try:
         import ray_tpu

@@ -79,12 +79,14 @@ def lint_runtime() -> List[str]:
     """Instantiate every library metric set into the process registry and
     validate everything registered there."""
     from ray_tpu.data._metrics import data_metrics
+    from ray_tpu.llm._metrics import llm_metrics
     from ray_tpu.serve._metrics import serve_metrics
     from ray_tpu.train._metrics import train_metrics
 
     serve_metrics()
     data_metrics()
     train_metrics()
+    llm_metrics()
     return M.validate_registry(M.default_registry)
 
 

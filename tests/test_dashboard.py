@@ -149,6 +149,7 @@ def test_history_endpoint_shapes(cluster):
     data_view = get("/api/data")
     assert set(data_view) == {"operators", "pipelines"}
     assert isinstance(get("/api/train"), dict)
+    assert isinstance(get("/api/llm"), dict)
 
 
 def test_state_log_api(cluster):

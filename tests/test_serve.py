@@ -360,6 +360,125 @@ def test_model_cache_lru_semantics():
     asyncio.run(main())
 
 
+def test_streaming_generator_deployment(serve_instance):
+    """A generator-returning deployment streams: the handle yields a
+    ResponseStream delivering items in order, and the HTTP proxy renders
+    chunked SSE that arrives incrementally — not buffered to completion
+    (reference: serve streaming responses)."""
+    import http.client
+    import json
+
+    from ray_tpu.serve._streaming import ResponseStream
+
+    @serve.deployment
+    class Gen:
+        def __call__(self, n):
+            def it():
+                for i in range(int(n)):
+                    time.sleep(0.1)
+                    yield {"i": i}
+            return it()
+
+    h = serve.run(Gen.bind(), name="genapp", route_prefix="/gen")
+    try:
+        out = h.remote(5).result(60)
+        assert isinstance(out, ResponseStream)
+        assert list(out) == [{"i": i} for i in range(5)]
+
+        port = serve.start(http_port=0)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("POST", "/gen", body=json.dumps(6),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type") == "text/event-stream"
+        stamps, events = [], []
+        t0 = time.monotonic()
+        while True:
+            line = resp.fp.readline()
+            if not line:
+                break
+            line = line.strip()
+            if not line.startswith(b"data:"):
+                continue
+            stamps.append(time.monotonic() - t0)
+            if line == b"data: [DONE]":
+                events.append("DONE")
+                break
+            events.append(json.loads(line[len(b"data:"):]))
+        conn.close()
+        assert events == [{"i": i} for i in range(6)] + ["DONE"]
+        # incremental: the first event lands well before the last — a
+        # buffered-to-completion proxy would deliver them all at once
+        assert stamps[-1] - stamps[0] > 0.25, stamps
+    finally:
+        serve.delete("genapp")
+
+
+def test_async_generator_deployment_streams(serve_instance):
+    @serve.deployment
+    class AGen:
+        async def __call__(self, n):
+            async def it():
+                import asyncio
+
+                for i in range(int(n)):
+                    await asyncio.sleep(0.02)
+                    yield i * 10
+            return it()
+
+    h = serve.run(AGen.bind(), name="agen", route_prefix="/agen")
+    try:
+        assert list(h.remote(4).result(60)) == [0, 10, 20, 30]
+    finally:
+        serve.delete("agen")
+
+
+def test_batcher_cancelled_caller_does_not_poison_batch():
+    """Regression: one caller cancelling mid-flight must not divert its
+    co-batched requests to the exception path — every surviving future
+    still gets its own result (serve/batching.py per-future guards)."""
+    import asyncio
+
+    from ray_tpu.serve.batching import _Batcher
+
+    ran = []
+
+    async def fn(xs):
+        ran.append(list(xs))
+        await asyncio.sleep(0.05)
+        return [x * 2 for x in xs]
+
+    async def main():
+        b = _Batcher(fn, max_batch_size=3, batch_wait_timeout_s=5.0)
+        t0 = asyncio.ensure_future(b.submit(None, 0))
+        t1 = asyncio.ensure_future(b.submit(None, 1))
+        await asyncio.sleep(0)        # both queued, batch not yet full
+        t0.cancel()                   # caller 0 walks away
+        # third submission fills the batch and triggers the run
+        t2 = asyncio.ensure_future(b.submit(None, 2))
+        done = await asyncio.gather(t0, t1, t2, return_exceptions=True)
+        assert isinstance(done[0], asyncio.CancelledError)
+        assert done[1] == 2 and done[2] == 4, done
+        assert ran == [[0, 1, 2]]
+
+        # exception path: a failing batch fn still resolves only the
+        # non-cancelled futures
+        async def boom(xs):
+            raise RuntimeError("model exploded")
+
+        b2 = _Batcher(boom, max_batch_size=2, batch_wait_timeout_s=5.0)
+        u0 = asyncio.ensure_future(b2.submit(None, 0))
+        await asyncio.sleep(0)
+        u0.cancel()
+        u1 = asyncio.ensure_future(b2.submit(None, 1))
+        out = await asyncio.gather(u0, u1, return_exceptions=True)
+        assert isinstance(out[0], asyncio.CancelledError)
+        assert isinstance(out[1], RuntimeError)
+
+    asyncio.run(main())
+
+
 def test_multiplexed_requires_model_id(serve_instance):
     @serve.deployment(num_replicas=1)
     class M:
