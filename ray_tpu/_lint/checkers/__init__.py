@@ -1,0 +1,16 @@
+"""Checker suite — importing this package registers every checker.
+
+Add a checker by dropping a module here that defines a
+:class:`ray_tpu._lint.core.Checker` subclass decorated with ``@register``,
+and importing it below (explicit imports keep registration order — and
+therefore reporter output — deterministic).
+"""
+
+from ray_tpu._lint.checkers import (  # noqa: F401
+    async_blocking,
+    collective_timeout,
+    config_drift,
+    lock_discipline,
+    metrics_hygiene,
+    tracer_hygiene,
+)

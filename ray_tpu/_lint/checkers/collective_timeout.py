@@ -1,0 +1,130 @@
+"""collective-timeout: every host-side collective op must be bounded.
+
+Hangs in collectives are the dominant failure mode at scale (Efficient
+AllReduce with Stragglers, arXiv:2505.23523; The Big Send-off,
+arXiv:2504.18658): one absent rank parks the whole gang forever unless the
+wait is bounded.  This runtime's CollectiveTimeout machinery names the
+lagging rank — but only if the call site can reach it, which means every
+``recv``/``barrier``/collective entry point must accept ``timeout_s``
+(defaulting to ``RayConfig.collective_default_timeout_s``) and every caller
+must either pass one or inherit that default.
+
+Two sub-rules:
+
+- ``collective-timeout.def`` — a def named like a collective op inside
+  ``ray_tpu/util/collective/`` that does not take ``timeout_s``.  (The XLA
+  backend's in-device collectives run inside jit where wall-clock timeouts
+  are not expressible — that file carries a documented
+  ``lint: disable-file`` and is covered by the hang watchdog instead.)
+- ``collective-timeout.call`` — a call through the collective API (module
+  alias or ``from ... import recv``) to an op we cannot see a
+  timeout-defaulted def for, without an explicit ``timeout_s=``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set
+
+from ray_tpu._lint.core import Checker, FileCtx, Finding, register
+
+COLLECTIVE_OPS = {"allreduce", "allgather", "reducescatter", "broadcast",
+                  "barrier", "send", "recv"}
+_COLLECTIVE_MODULE = "ray_tpu.util.collective"
+
+
+def _collective_aliases(tree: ast.AST) -> tuple:
+    """(module aliases, function aliases) bound to the collective package
+    in this file."""
+    mod_aliases: Set[str] = set()
+    fn_aliases: Dict[str, str] = {}  # local name -> op name
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.startswith(_COLLECTIVE_MODULE):
+                    mod_aliases.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.startswith(_COLLECTIVE_MODULE):
+                for a in node.names:
+                    if a.name in COLLECTIVE_OPS:
+                        fn_aliases[a.asname or a.name] = a.name
+                    elif a.name in ("collective", "xla"):
+                        mod_aliases.add(a.asname or a.name)
+            elif mod == "ray_tpu.util":
+                for a in node.names:
+                    if a.name == "collective":
+                        mod_aliases.add(a.asname or a.name)
+    return mod_aliases, fn_aliases
+
+
+def _has_timeout_param(fn) -> bool:
+    args = fn.args
+    names = [a.arg for a in args.args + args.kwonlyargs + args.posonlyargs]
+    return "timeout_s" in names or args.kwarg is not None
+
+
+@register
+class CollectiveTimeoutChecker(Checker):
+    name = "collective-timeout"
+    description = ("collective op defs and call sites that can wait forever "
+                   "— no timeout_s parameter or argument")
+
+    def check_tree(self, files: List[FileCtx]) -> Iterable[Finding]:
+        # pass 1: signature map of the host-side collective module's defs
+        defaulted_defs: Set[str] = set()
+        out: List[Finding] = []
+        for ctx in files:
+            if "util/collective/" not in ctx.relpath:
+                continue
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and node.name in COLLECTIVE_OPS:
+                    if _has_timeout_param(node):
+                        defaulted_defs.add(node.name)
+                    else:
+                        out.append(ctx.finding(
+                            "collective-timeout.def", node,
+                            f"collective op `{node.name}` takes no "
+                            f"`timeout_s` — an absent rank hangs callers "
+                            f"forever; accept timeout_s=None and default "
+                            f"to RayConfig.collective_default_timeout_s"))
+        # pass 2: call sites through the collective API elsewhere
+        for ctx in files:
+            if "util/collective/" in ctx.relpath:
+                continue
+            mod_aliases, fn_aliases = _collective_aliases(ctx.tree)
+            if not mod_aliases and not fn_aliases:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                op = self._resolve_op(node.func, mod_aliases, fn_aliases)
+                if op is None:
+                    continue
+                if any(kw.arg == "timeout_s" for kw in node.keywords):
+                    continue
+                if op in defaulted_defs:
+                    continue  # inherits the module default — bounded
+                out.append(ctx.finding(
+                    "collective-timeout.call", node,
+                    f"collective `{op}` called without `timeout_s` and the "
+                    f"resolved op has no bounded default — pass timeout_s= "
+                    f"so a straggler raises CollectiveTimeout instead of "
+                    f"hanging"))
+        return out
+
+    @staticmethod
+    def _resolve_op(func, mod_aliases: Set[str], fn_aliases: Dict[str, str]):
+        if isinstance(func, ast.Name):
+            return fn_aliases.get(func.id)
+        if isinstance(func, ast.Attribute) and func.attr in COLLECTIVE_OPS:
+            base = func.value
+            if isinstance(base, ast.Name) and base.id in mod_aliases:
+                return func.attr
+            # collective.collective.recv(...) / col.xla.allreduce(...)
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id in mod_aliases:
+                return func.attr
+        return None

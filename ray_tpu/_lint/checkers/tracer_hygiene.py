@@ -1,0 +1,146 @@
+"""jax-tracer-hygiene: host-sync coercions and Python side effects inside
+``jax.jit``/``pjit``-compiled functions.
+
+Inside a traced function, ``float(x)``/``int(x)``/``bool(x)``,
+``np.asarray(x)``, ``.item()`` and ``.tolist()`` force the tracer to
+concretize — at best a silent host sync that serializes the device stream
+(the exact straggler shape the hang watchdog exists to catch), at worst a
+``TracerArrayConversionError`` only on the TPU path that CPU tests never
+exercise.  ``print`` and ``time.*`` run at TRACE time, not per step — a
+classic silent-wrong-observability bug.
+
+Detection: defs decorated with ``jit``/``jax.jit``/``pjit``/
+``partial(jax.jit, ...)``, plus local functions/methods passed to a
+``jax.jit(...)`` call in the same module (``self._step = jax.jit(self._fn)``
+marks ``_fn``).  Numpy calls on literal constants are fine (trace-time
+constant folding) and not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from ray_tpu._lint.core import Checker, FileCtx, Finding, register
+
+_JIT_NAMES = {"jit", "pjit"}
+_NP_SYNC_FUNCS = {"asarray", "array", "copy"}
+_SYNC_METHODS = {"item", "tolist"}
+_COERCIONS = {"float", "int", "bool"}
+
+
+def _is_jit_expr(node) -> bool:
+    """True for `jit`, `jax.jit`, `pjit`, `partial(jax.jit, ...)`."""
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_NAMES
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_NAMES
+    if isinstance(node, ast.Call):
+        f = node.func
+        fname = getattr(f, "id", None) or getattr(f, "attr", None)
+        if fname == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+        return _is_jit_expr(f)
+    return False
+
+
+def _jitted_local_names(tree: ast.AST) -> Set[str]:
+    """Names of local defs wrapped by a jit(...) CALL somewhere in the
+    module: `jax.jit(step_fn)`, `jax.jit(self._train_step, ...)`."""
+    names: Set[str] = set()
+    def _local_target(arg) -> Optional[str]:
+        if isinstance(arg, ast.Name):
+            return arg.id
+        # only `self.<attr>` resolves locally — `jax.jit(other.obj.fn)`
+        # jits a DIFFERENT object's method, which may share a name with a
+        # method here (rllib's env runners do exactly this)
+        if isinstance(arg, ast.Attribute) and isinstance(arg.value, ast.Name) \
+                and arg.value.id == "self":
+            return arg.attr
+        return None
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            for arg in node.args[:1]:
+                tgt = _local_target(arg)
+                if tgt is None and isinstance(arg, ast.Call):
+                    # jax.jit(partial(self._fn, ...))
+                    fname = getattr(arg.func, "id", None) \
+                        or getattr(arg.func, "attr", None)
+                    if fname == "partial" and arg.args:
+                        tgt = _local_target(arg.args[0])
+                if tgt:
+                    names.add(tgt)
+    return names
+
+
+class _TracedBodyVisitor(ast.NodeVisitor):
+    """Flag host-sync shapes inside one traced function body.  Does not
+    descend into nested defs that are themselves fine (closures under jit
+    still trace, so nested defs ARE visited — only lambdas passed to numpy
+    reducers etc. would over-trigger, and those are visited too: inside a
+    traced region everything traces)."""
+
+    def __init__(self, ctx: FileCtx, fn_name: str):
+        self.ctx = ctx
+        self.fn = fn_name
+        self.findings: List[Finding] = []
+
+    def _flag(self, node, what: str) -> None:
+        self.findings.append(self.ctx.finding(
+            "jax-tracer-hygiene", node,
+            f"{what} inside jit-compiled `{self.fn}` — forces a host sync "
+            f"or runs at trace time, not per step"))
+
+    def visit_Call(self, node: ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id in _COERCIONS and node.args \
+                    and not isinstance(node.args[0], ast.Constant):
+                self._flag(node, f"`{f.id}(...)` coercion")
+            elif f.id == "print":
+                self._flag(node, "`print(...)`")
+        elif isinstance(f, ast.Attribute):
+            base = f.value
+            if isinstance(base, ast.Name):
+                if base.id in ("np", "numpy") and f.attr in _NP_SYNC_FUNCS \
+                        and node.args \
+                        and not _is_constant_arg(node.args[0]):
+                    self._flag(node, f"`{base.id}.{f.attr}(...)` on a "
+                                     f"traced value")
+                elif base.id == "time":
+                    self._flag(node, f"`time.{f.attr}()`")
+            if f.attr in _SYNC_METHODS and not node.args:
+                self._flag(node, f"`.{f.attr}()`")
+        self.generic_visit(node)
+
+
+def _is_constant_arg(node) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.List, ast.Tuple)):
+        return all(_is_constant_arg(e) for e in node.elts)
+    return False
+
+
+@register
+class TracerHygieneChecker(Checker):
+    name = "jax-tracer-hygiene"
+    description = ("host-sync coercions (float()/np.asarray()/.item()) and "
+                   "trace-time side effects (print/time) inside "
+                   "jit/pjit-compiled functions")
+
+    def check_file(self, ctx: FileCtx) -> Iterable[Finding]:
+        jitted = _jitted_local_names(ctx.tree)
+        out: List[Finding] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not (node.name in jitted
+                    or any(_is_jit_expr(d) for d in node.decorator_list)):
+                continue
+            v = _TracedBodyVisitor(ctx, node.name)
+            for stmt in node.body:
+                v.visit(stmt)
+            out.extend(v.findings)
+        return out

@@ -93,14 +93,11 @@ _d("worker_register_timeout_s", float, 60.0, "worker must register with nodelet 
 _d("wait_poll_interval_ms", int, 20, "poll granularity for ray.wait fallbacks")
 
 # --- Worker pool ---
-_d("num_initial_python_workers", int, 0, "workers pre-started per nodelet")
 _d("maximum_startup_concurrency", int, 4, "max concurrently-starting workers")
 _d("idle_worker_killing_time_ms", int, 300_000, "idle worker reap delay")
-_d("max_io_workers", int, 2, "spill/restore IO workers")
 
 # --- Scheduler ---
 _d("scheduler_spread_threshold", float, 0.5, "hybrid policy: pack below this utilization, then spread")
-_d("scheduler_top_k_fraction", float, 0.2, "hybrid policy: random choice among top-k nodes")
 _d("max_pending_lease_requests_per_scheduling_category", int, 10, "pipelined lease requests")
 _d("lease_pipeline_depth", int, 48, "in-flight tasks per leased worker (exec queue serializes)")
 _d("worker_exec_threads", int, 12, "executor threads per worker (chunks share threads, so this can be < pipeline depth)")
@@ -121,8 +118,6 @@ _d("gcs_restart_actor_grace_s", float, 10.0, "restarted GCS waits this long for 
 _d("task_max_retries_default", int, 3, "default retries for tasks (on worker/node death)")
 _d("max_lease_spillbacks", int, 4, "max times one lease request hops between nodelets before it must settle")
 _d("actor_max_restarts_default", int, 0, "default actor restarts")
-_d("lineage_enabled", bool, True, "enable lineage-based object recovery")
-_d("max_lineage_bytes", int, 256 * 1024**2, "lineage retention budget per owner")
 
 # --- Memory monitor ---
 _d("memory_monitor_refresh_ms", int, 1000, "node memory pressure check period; 0 disables")
@@ -153,8 +148,30 @@ _d("hang_p95_floor_s", float, 5.0,
 _d("hang_min_samples", int, 5,
    "completed same-name tasks required before the p95 path applies")
 
-# --- Logging ---
-_d("log_to_driver", bool, True, "forward worker stdout/stderr to the driver")
+# --- Event loop / channels ---
+_d("loop_stall_threshold_s", float, 5.0,
+   "warn (with the loop thread's stack) when the per-process IO event loop "
+   "stops heartbeating this long; 0 disables; env re-read per loop start")
+_d("chan_connect_timeout_s", float, 60.0,
+   "compiled-DAG tcp channel connect/accept budget (tests shorten it); "
+   "env re-read per channel construction")
+_d("native_channel", str, "",
+   "compiled-DAG channel backend: '1' forces native futex channels, '0' "
+   "the Python fallback, '' auto-selects by core count")
+
+# --- Sanitizers ---
+_d("race_detector", bool, False,
+   "wrap max_concurrency>1 actors so unsynchronized shared-state writes "
+   "are reported (see _private/race_detector.py)")
+_d("race_detector_allow", str, "",
+   "comma-separated ClassName.attr suppressions for the race detector; "
+   "env re-read per report so suppressions apply live")
+
+# --- Storage roots ---
+_d("workflow_storage", str, "~/ray_tpu_workflows",
+   "filesystem root for workflow checkpoints")
+_d("storage_path", str, "~/ray_tpu_results",
+   "default air.RunConfig.storage_path (trial results + checkpoints)")
 
 # --- Collectives ---
 _d("collective_rendezvous_timeout_s", float, 60.0, "collective group formation timeout")

@@ -461,11 +461,14 @@ class CoreWorker:
 
     # ====================================================== setup / teardown
     def register_with_nodelet(self):
+        # bounded: a wedged nodelet must fail the worker's startup loudly,
+        # not park it in an unkillable unregistered state
         return self.io.run(
             self.nodelet_conn.call(
                 "register_worker",
                 {"worker_id": self.worker_id.binary(), "addr": list(self.addr),
                  "pid": os.getpid()},
+                timeout=RayConfig.worker_register_timeout_s,
             )
         )
 

@@ -16,12 +16,21 @@ An overlapping write is the shape of an unsynchronized actor-state race
 (attribute, both method names, thread ids) and logs a warning with the
 writing stack.  Reads are not tracked.
 
-CONSERVATIVE BY DESIGN: the detector sees method overlap, not lock
-ownership — a write correctly guarded by the user's own ``threading.Lock``
-is still reported as a POSSIBLE race (TSAN-grade lockset tracking would
-need to instrument every lock).  Suppress known-synchronized attributes
-with :func:`suppress` ("ClassName.attr") or the
-``RAY_TPU_RACE_DETECTOR_ALLOW`` env var (comma-separated).
+LOCK-AWARE: when the wrapped instance carries ``threading.Lock``/``RLock``/
+``Condition`` attributes, they are replaced with tracking proxies so the
+detector knows which locks the WRITING thread holds.  A concurrent write
+made under any of the instance's own locks is recorded with
+``kind="guarded"`` (visible, but not warned about — the user's lock
+discipline is working); a write with no lock held stays
+``kind="possible_race"`` with a warning.  Locks the detector cannot see
+(globals, other objects) still report conservatively.
+
+Suppress known-synchronized attributes with :func:`suppress`
+("ClassName.attr"), the ``RAY_TPU_RACE_DETECTOR_ALLOW`` env var /
+``RayConfig.race_detector_allow`` flag (comma-separated), or the shared
+``_private/sync_suppressions.KNOWN_SYNCHRONIZED`` list — the same list the
+static lock-discipline lint rule reads, so one stated justification covers
+both analyses.
 
 Reports are queryable in-process via :func:`get_reports` and surface in
 the actor's worker log.
@@ -33,7 +42,7 @@ import logging
 import os
 import threading
 import traceback
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 logger = logging.getLogger(__name__)
 
@@ -42,14 +51,20 @@ _inflight: Dict[int, Dict[int, str]] = {}   # id(instance) -> {thread_id: method
 _reports: List[Dict[str, Any]] = []
 _MAX_REPORTS = 256
 
+# per-thread stack of _TrackedLock proxies currently held (reentrant
+# acquires push twice, matching their paired releases)
+_held = threading.local()
+
 
 def enabled() -> bool:
     from ray_tpu._private.config import RayConfig
 
+    # env re-read per actor creation (runtime_env-injected vars must apply
+    # live); the registered flag carries the default for config dumps
     env = os.environ.get("RAY_TPU_RACE_DETECTOR")
     if env is not None:
         return env.strip().lower() not in ("", "0", "false", "no", "off")
-    return bool(getattr(RayConfig, "race_detector", False))
+    return bool(RayConfig.race_detector)
 
 
 _suppressed: set = set()
@@ -62,15 +77,96 @@ def suppress(class_attr: str) -> None:
 
 
 def _suppressed_set() -> set:
-    env = os.environ.get("RAY_TPU_RACE_DETECTOR_ALLOW", "")
+    from ray_tpu._private.config import RayConfig
+    from ray_tpu._private.sync_suppressions import KNOWN_SYNCHRONIZED
+
+    env = os.environ.get("RAY_TPU_RACE_DETECTOR_ALLOW")
+    if env is None:
+        env = RayConfig.race_detector_allow
     out = {s.strip() for s in env.split(",") if s.strip()}
+    out |= KNOWN_SYNCHRONIZED
     with _lock:
         return out | _suppressed
 
 
-def get_reports() -> List[Dict[str, Any]]:
+# ------------------------------------------------------- lock tracking
+
+class _TrackedLock:
+    """Transparent proxy over a lock-ish object (Lock/RLock/Condition)
+    registering per-thread ownership, so a guarded write can be told apart
+    from a naked one."""
+
+    __slots__ = ("_inner",)
+
+    def __init__(self, inner):
+        object.__setattr__(self, "_inner", inner)
+
+    def _push(self):
+        stack = getattr(_held, "stack", None)
+        if stack is None:
+            stack = _held.stack = []
+        stack.append(id(self))
+
+    def _pop(self):
+        stack = getattr(_held, "stack", None)
+        if stack:
+            try:
+                stack.remove(id(self))
+            except ValueError:
+                pass
+
+    def acquire(self, *args, **kwargs):
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._push()
+        return got
+
+    def release(self, *args, **kwargs):
+        self._inner.release(*args, **kwargs)
+        self._pop()
+
+    def __enter__(self):
+        out = self._inner.__enter__()
+        self._push()
+        return out
+
+    def __exit__(self, *exc):
+        self._pop()
+        return self._inner.__exit__(*exc)
+
+    def __getattr__(self, name):
+        # wait()/notify()/locked()/_is_owned() etc. forward to the real lock
+        return getattr(object.__getattribute__(self, "_inner"), name)
+
+
+def _thread_holds_lock() -> bool:
+    return bool(getattr(_held, "stack", None))
+
+
+def _lock_types() -> tuple:
+    return (type(threading.Lock()), type(threading.RLock()),
+            threading.Condition)
+
+
+def _proxy_instance_locks(instance: Any) -> None:
+    """Swap the instance's lock attributes for tracking proxies (direct
+    ``__dict__`` surgery: runs before/independently of the __setattr__
+    override)."""
+    d = getattr(instance, "__dict__", None)
+    if not isinstance(d, dict):
+        return
+    types = _lock_types()
+    for key, val in list(d.items()):
+        if isinstance(val, types):
+            d[key] = _TrackedLock(val)
+
+
+def get_reports(kind: Optional[str] = None) -> List[Dict[str, Any]]:
+    """All reports, or only one ``kind`` ("possible_race" / "guarded")."""
     with _lock:
-        return list(_reports)
+        if kind is None:
+            return list(_reports)
+        return [r for r in _reports if r.get("kind") == kind]
 
 
 def clear_reports() -> None:
@@ -105,9 +201,11 @@ def _record(instance, attr: str, writer_method: str, others: Dict[int, str]):
     cls_name = type(instance).__name__.replace("(race-checked)", "")
     if f"{cls_name}.{attr}" in _suppressed_set():
         return
+    guarded = _thread_holds_lock()
     report = {
         "class": cls_name,
         "attribute": attr,
+        "kind": "guarded" if guarded else "possible_race",
         "writer": writer_method,
         "writer_thread": threading.get_ident(),
         "concurrent": dict(others),
@@ -116,6 +214,12 @@ def _record(instance, attr: str, writer_method: str, others: Dict[int, str]):
     with _lock:
         if len(_reports) < _MAX_REPORTS:
             _reports.append(report)
+    if guarded:
+        # the writer held one of the instance's own locks: the user's
+        # discipline is working — record for inspection, don't cry wolf
+        logger.debug("guarded concurrent write: %s.%s by %r",
+                     report["class"], attr, writer_method)
+        return
     logger.warning(
         "POSSIBLE RACE: actor %s attribute %r written by %r while %s "
         "executed concurrently on other threads.  If this write is guarded "
@@ -129,8 +233,10 @@ def _record(instance, attr: str, writer_method: str, others: Dict[int, str]):
 def wrap_instance(instance: Any) -> Any:
     """Return an instance whose attribute writes are race-checked: a dynamic
     subclass overriding ``__setattr__`` (the original class is untouched —
-    other instances stay unwrapped)."""
+    other instances stay unwrapped).  The instance's own lock attributes
+    become tracking proxies so guarded writes downgrade (see module doc)."""
     cls = type(instance)
+    _proxy_instance_locks(instance)
 
     def __setattr__(self, name, value):  # noqa: N807
         me = threading.get_ident()

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional, Tuple
 
+from ray_tpu._private.config import RayConfig
 from ray_tpu._private.task_spec import SchedulingStrategy
 from ray_tpu.util.scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
@@ -22,7 +23,7 @@ TASK_DEFAULTS = {
     "num_gpus": 0.0,
     "resources": None,
     "num_returns": 1,
-    "max_retries": 3,
+    "max_retries": None,   # None -> RayConfig.task_max_retries_default
     "retry_exceptions": False,
     "scheduling_strategy": None,
     "runtime_env": None,
@@ -35,7 +36,7 @@ ACTOR_DEFAULTS = {
     "num_tpus": 0.0,
     "num_gpus": 0.0,
     "resources": None,
-    "max_restarts": 0,
+    "max_restarts": None,  # None -> RayConfig.actor_max_restarts_default
     "max_task_retries": 0,
     "max_concurrency": 1,
     "scheduling_strategy": None,
@@ -61,6 +62,12 @@ def merge_options(defaults: Dict[str, Any], *layers: Optional[Dict[str, Any]]) -
 
         # Reject unknown/unsupported fields at SUBMISSION, not on the worker.
         out["runtime_env"] = renv.validate(out["runtime_env"])
+    # config-backed defaults resolve at merge time, so the cluster-wide
+    # knobs apply without every call site knowing about them
+    if "max_retries" in defaults and out.get("max_retries") is None:
+        out["max_retries"] = RayConfig.task_max_retries_default
+    if "max_restarts" in defaults and out.get("max_restarts") is None:
+        out["max_restarts"] = RayConfig.actor_max_restarts_default
     return out
 
 

@@ -515,9 +515,14 @@ class EventLoopThread:
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._thread.start()
         if stall_threshold_s is None:
+            # env re-read per loop start (set_env retunes live processes);
+            # the registered flag carries the typed default
             try:
-                stall_threshold_s = float(
-                    os.environ.get("RAY_TPU_LOOP_STALL_THRESHOLD_S", "5"))
+                env = os.environ.get("RAY_TPU_LOOP_STALL_THRESHOLD_S")
+                from ray_tpu._private.config import RayConfig
+
+                stall_threshold_s = float(env) if env is not None \
+                    else RayConfig.loop_stall_threshold_s
             except ValueError:
                 stall_threshold_s = 5.0  # a bad knob must not kill startup
         if stall_threshold_s > 0:

@@ -93,5 +93,8 @@ class RunConfig:
 
     def __post_init__(self):
         if self.storage_path is None:
+            from ray_tpu._private.config import RayConfig
+
             self.storage_path = os.path.expanduser(
-                os.environ.get("RAY_TPU_STORAGE_PATH", "~/ray_tpu_results"))
+                os.environ.get("RAY_TPU_STORAGE_PATH")
+                or RayConfig.storage_path)

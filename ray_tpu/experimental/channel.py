@@ -64,6 +64,10 @@ def _native_wanted() -> bool:
     import os
 
     env = os.environ.get("RAY_TPU_NATIVE_CHANNEL")
+    if env is None:
+        from ray_tpu._private.config import RayConfig
+
+        env = RayConfig.native_channel or None  # '' = auto-select
     if env is not None:
         return env.strip().lower() not in ("0", "false", "no", "off", "")
     return (os.cpu_count() or 1) > 1
@@ -358,8 +362,15 @@ class TcpChannel:
         self._listener: Optional[socket.socket] = None
         self._credits = depth
         if connect_timeout is None:
-            connect_timeout = float(
-                os.environ.get("RAY_TPU_CHAN_CONNECT_TIMEOUT_S", 60.0))
+            # env re-read per construction (tests shorten it mid-process);
+            # the registered flag carries the typed default
+            env = os.environ.get("RAY_TPU_CHAN_CONNECT_TIMEOUT_S")
+            if env is not None:
+                connect_timeout = float(env)
+            else:
+                from ray_tpu._private.config import RayConfig
+
+                connect_timeout = RayConfig.chan_connect_timeout_s
         self._connect_timeout = connect_timeout
         # dial/accept may run on a background thread (the compiled DAG's
         # driver dials its output edges at execute time) while a reader

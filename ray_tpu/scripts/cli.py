@@ -13,6 +13,7 @@ Usage:
     python -m ray_tpu stop
     python -m ray_tpu job submit [--address A] -- CMD...
     python -m ray_tpu job list/status/logs/stop [ID]
+    python -m ray_tpu lint [PATHS...] [--json] [--baseline PATH]
     python -m ray_tpu timeline [--output PATH]
     python -m ray_tpu profile [--name TASK]
     python -m ray_tpu summary tasks|serve|data|train|llm|hangs
@@ -482,6 +483,40 @@ def _cmd_job(args) -> int:
         client.close()
 
 
+def _cmd_lint(args) -> int:
+    """Static distributed-runtime invariant checks (no cluster needed):
+    async-blocking, lock discipline, config drift, collective timeouts, JAX
+    tracer hygiene, metrics hygiene — see ray_tpu/_lint/ and
+    docs/ARCHITECTURE.md §7.  Exit 1 on any non-baselined finding."""
+    from ray_tpu import _lint
+
+    if args.list_rules:
+        for name, cls in _lint.all_checkers().items():
+            print(f"{name:22} {cls.description}")
+        return 0
+    baseline = None if args.no_baseline else (args.baseline
+                                              or _lint.DEFAULT_BASELINE)
+    checkers = args.select.split(",") if args.select else None
+    result = _lint.run_lint(paths=args.paths or None, checkers=checkers,
+                            baseline=baseline)
+    if args.update_baseline:
+        if baseline is None:
+            raise SystemExit("--update-baseline needs a baseline path "
+                             "(drop --no-baseline)")
+        notes = {fp: e.get("note", "")
+                 for fp, e in _lint.load_baseline(baseline).items()}
+        every = sorted(result.findings + result.baselined,
+                       key=_lint.Finding.key)
+        _lint.save_baseline(baseline, every, notes)
+        print(f"baseline updated: {len(every)} entr(ies) -> {baseline}")
+        return 0
+    if args.json:
+        print(_lint.render_json(result))
+    else:
+        print(_lint.render_text(result, verbose=args.verbose))
+    return 0 if result.ok else 1
+
+
 def _cmd_up(args) -> int:
     from ray_tpu.autoscaler.launcher import cluster_up
 
@@ -528,6 +563,27 @@ def main(argv=None) -> int:
                        help="tear down a cluster launched with `up`")
     p.add_argument("config", help="cluster YAML path")
     p.set_defaults(fn=_cmd_down)
+
+    p = sub.add_parser(
+        "lint", help="static distributed-runtime invariant checks "
+        "(AST-based; exit 1 on non-baselined findings)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to lint (default: the ray_tpu package)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report (deterministic)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: ray_tpu/_lint/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report grandfathered findings as failures too")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="grandfather every current finding into the baseline")
+    p.add_argument("--select", default=None,
+                   help="comma-separated checker names (default: all)")
+    p.add_argument("--verbose", action="store_true",
+                   help="also print baselined findings")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the checker table and exit")
+    p.set_defaults(fn=_cmd_lint)
 
     p = sub.add_parser("status", help="cluster nodes + pending demand")
     p.add_argument("--address", default=None)

@@ -315,10 +315,12 @@ class Group:
         self.allreduce(np.zeros((), np.float32), timeout_s=timeout_s,
                        _op_name="barrier")
 
-    def send(self, array, dst_rank: int, tag: int = 0):
+    def send(self, array, dst_rank: int, tag: int = 0,
+             timeout_s: Optional[float] = None):
         # Tagged p2p rides its own seq namespace (negative tags avoid
         # colliding with collective seqs).
-        self._send_to(dst_rank, np.asarray(array), -1, tag=tag + 2)
+        self._send_to(dst_rank, np.asarray(array), -1, tag=tag + 2,
+                      deadline=self._deadline(timeout_s))
 
     def recv(self, src_rank: int, tag: int = 0,
              timeout_s: Optional[float] = None):
@@ -378,24 +380,35 @@ def get_collective_group_size(group_name: str = "default") -> int:
     return _group(group_name).world_size
 
 
-def allreduce(tensor, group_name: str = "default", op: str = "sum"):
-    return _group(group_name).allreduce(tensor, op)
+# Every public op takes ``timeout_s`` (default
+# RayConfig.collective_default_timeout_s): a gang with one absent rank
+# raises CollectiveTimeout naming the laggard instead of hanging forever
+# (enforced tree-wide by the `collective-timeout` lint rule).
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum",
+              timeout_s: Optional[float] = None):
+    return _group(group_name).allreduce(tensor, op, timeout_s=timeout_s)
 
 
-def allgather(tensor, group_name: str = "default"):
-    return _group(group_name).allgather(tensor)
+def allgather(tensor, group_name: str = "default",
+              timeout_s: Optional[float] = None):
+    return _group(group_name).allgather(tensor, timeout_s=timeout_s)
 
 
-def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
-    return _group(group_name).reducescatter(tensor, op)
+def reducescatter(tensor, group_name: str = "default", op: str = "sum",
+                  timeout_s: Optional[float] = None):
+    return _group(group_name).reducescatter(tensor, op, timeout_s=timeout_s)
 
 
-def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    return _group(group_name).broadcast(tensor, root=src_rank)
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
+              timeout_s: Optional[float] = None):
+    return _group(group_name).broadcast(tensor, root=src_rank,
+                                        timeout_s=timeout_s)
 
 
-def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0):
-    _group(group_name).send(tensor, dst_rank, tag)
+def send(tensor, dst_rank: int, group_name: str = "default", tag: int = 0,
+         timeout_s: Optional[float] = None):
+    _group(group_name).send(tensor, dst_rank, tag, timeout_s=timeout_s)
 
 
 def recv(src_rank: int, group_name: str = "default", tag: int = 0,

@@ -4,6 +4,12 @@ These are meant to be called inside jit/shard_map where ``axis_name`` is bound;
 XLA lowers them to ICI all-reduce/all-gather/collective-permute — the NCCL
 replacement (reference lowers ray.util.collective to cupy/NCCL launches;
 here the compiler owns scheduling and fusion).
+
+In-device collectives run inside the compiled program, where a wall-clock
+``timeout_s`` is not expressible — a straggling chip is the hang watchdog's
+job (nodelet polls busy workers; see docs/ARCHITECTURE.md §5c), not a
+Python-level deadline's.
+# lint: disable-file=collective-timeout
 """
 
 from __future__ import annotations

@@ -23,12 +23,13 @@ from typing import Any, Dict, List, Optional
 import ray_tpu
 from ray_tpu.dag import DAGNode
 
-_DEFAULT_STORAGE = os.path.expanduser("~/ray_tpu_workflows")
-
-
 def _storage_root(storage: Optional[str]) -> str:
-    return os.path.expanduser(storage or os.environ.get(
-        "RAY_TPU_WORKFLOW_STORAGE", _DEFAULT_STORAGE))
+    from ray_tpu._private.config import RayConfig
+
+    return os.path.expanduser(
+        storage
+        or os.environ.get("RAY_TPU_WORKFLOW_STORAGE")
+        or RayConfig.workflow_storage)
 
 
 class _WorkflowStorage:

@@ -1,78 +1,60 @@
-"""Metrics-hygiene lint helper: walk every metric ray_tpu registers.
+"""Metrics-hygiene lint helpers — thin shim over the lint framework.
 
-Shared rules live in `ray_tpu._private.metrics.validate_registry` (valid
-bare Prometheus name, no ray_tpu_ double prefix, nonempty help text; a
-conflicting-kind duplicate raises at registration).  Two passes apply them:
-
-1. SOURCE: regex-walk ``ray_tpu/**/*.py`` for literal
-   Counter/Gauge/Histogram constructions — catches registration sites that
-   only run inside other processes (nodelet gauges, replica metrics)
-   without spinning those processes up.  Also flags one name constructed
-   as two different kinds anywhere in the tree.
-2. RUNTIME: instantiate every library metric-definition module into a
-   process registry and validate what actually registered.
-
-Used by tests/test_metrics_hygiene.py; importable from other suites.
+The source-walk and docs-table rules moved into the lint framework
+(``ray_tpu/_lint/checkers/metrics_hygiene.py``), where `ray_tpu lint` and
+tests/test_lint.py run them over the whole tree on every PR.  This module
+keeps the original helper API for tests/test_metrics_hygiene.py — plus
+``lint_runtime``, which instantiates the library metric-definition modules
+into a live registry (a runtime pass a static checker must not do).
 """
 
 from __future__ import annotations
 
 import os
-import re
-from typing import Dict, List, Tuple
+from typing import List, Tuple
 
 from ray_tpu._private import metrics as M
+from ray_tpu._lint import collect_files, run_lint
+from ray_tpu._lint.checkers.metrics_hygiene import collect_metrics
 
 RAY_TPU_ROOT = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "ray_tpu")
 
-# A literal construction: Kind("name"[, "description fragment" ...]).
-# \s spans newlines so the idiomatic wrapped call sites match; only the
-# first description fragment of an implicitly-concatenated string is
-# captured, which is enough for the nonempty check.
-_CONSTRUCT_RE = re.compile(
-    r"\b(Counter|Gauge|Histogram)\(\s*[\"']([^\"']+)[\"']"
-    r"(?:\s*,\s*[\"']([^\"']*)[\"'])?",
-    re.S)
+
+def _files():
+    return collect_files([RAY_TPU_ROOT])
 
 
 def collect_source_metrics() -> List[Tuple[str, str, str, str]]:
     """Every literal metric construction under ray_tpu/:
     (relpath, kind, name, first description fragment)."""
     out = []
-    for dirpath, _dirs, files in os.walk(RAY_TPU_ROOT):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, encoding="utf-8") as f:
-                text = f.read()
-            rel = os.path.relpath(path, RAY_TPU_ROOT)
-            for kind, name, desc in _CONSTRUCT_RE.findall(text):
-                out.append((rel, kind, name, desc or ""))
+    for ctx, _line, kind, name, desc in collect_metrics(_files()):
+        rel = ctx.relpath
+        if rel.startswith("ray_tpu/"):
+            rel = rel[len("ray_tpu/"):]
+        out.append((rel, kind, name, desc))
     return out
 
 
+def _checker_messages(sub_rules: Tuple[str, ...]) -> List[str]:
+    result = run_lint(files=_files(), checkers=["metrics-hygiene"],
+                      baseline=None)
+    return [f"{f.path}: {f.message}" for f in result.findings
+            if f.rule in sub_rules]
+
+
 def lint_source() -> List[str]:
-    problems: List[str] = []
-    kinds: Dict[str, Tuple[str, str]] = {}  # name -> (kind, first site)
-    for rel, kind, name, desc in collect_source_metrics():
-        site = f"{rel}: {kind}({name!r})"
-        if not M.METRIC_NAME_RE.match(name):
-            problems.append(f"{site}: invalid metric name")
-        if name.startswith("ray_tpu_"):
-            problems.append(
-                f"{site}: pre-prefixed name (export adds ray_tpu_)")
-        if not desc.strip():
-            problems.append(f"{site}: missing/empty help text")
-        prev = kinds.get(name)
-        if prev is not None and prev[0] != kind:
-            problems.append(
-                f"{site}: conflicts with {prev[1]} ({prev[0]}) — one name, "
-                "two metric kinds")
-        else:
-            kinds.setdefault(name, (kind, site))
-    return problems
+    return _checker_messages(("metrics-hygiene.name",
+                              "metrics-hygiene.prefix",
+                              "metrics-hygiene.help",
+                              "metrics-hygiene.kind"))
+
+
+def lint_docs() -> List[str]:
+    """Every metric the tree constructs must appear in the ARCHITECTURE.md
+    exported-series table (§5b)."""
+    return _checker_messages(("metrics-hygiene.docs",))
 
 
 def lint_runtime() -> List[str]:
@@ -88,28 +70,3 @@ def lint_runtime() -> List[str]:
     train_metrics()
     llm_metrics()
     return M.validate_registry(M.default_registry)
-
-
-# Metric names that appear in source only as documentation examples
-# (docstrings showing the user-defined metrics API) — not exported series.
-_DOC_EXAMPLE_NAMES = {"cache_hits"}
-
-_ARCHITECTURE_MD = os.path.join(
-    os.path.dirname(RAY_TPU_ROOT), "docs", "ARCHITECTURE.md")
-
-
-def lint_docs() -> List[str]:
-    """Every metric the tree constructs must appear in the ARCHITECTURE.md
-    exported-series table (§5b): an undocumented series is invisible to
-    operators and silently rots when renamed."""
-    with open(_ARCHITECTURE_MD, encoding="utf-8") as f:
-        doc = f.read()
-    problems = []
-    for rel, kind, name, _desc in collect_source_metrics():
-        if name in _DOC_EXAMPLE_NAMES:
-            continue
-        if name not in doc:
-            problems.append(
-                f"{rel}: {kind}({name!r}) is not documented in "
-                "docs/ARCHITECTURE.md's exported-series table")
-    return problems

@@ -5,7 +5,7 @@ from ray_tpu._private.config import RayConfig
 
 def test_defaults():
     assert RayConfig.heartbeat_interval_ms == 500
-    assert RayConfig.lineage_enabled is True
+    assert RayConfig.task_events_enabled is True
 
 
 def test_env_override(monkeypatch):
@@ -16,13 +16,13 @@ def test_env_override(monkeypatch):
 
 
 def test_set_and_overrides_env():
-    RayConfig.set("max_io_workers", 5)
+    RayConfig.set("maximum_startup_concurrency", 5)
     try:
-        assert RayConfig.max_io_workers == 5
+        assert RayConfig.maximum_startup_concurrency == 5
         env = RayConfig.overrides_as_env()
-        assert env["RAY_TPU_MAX_IO_WORKERS"] == "5"
+        assert env["RAY_TPU_MAXIMUM_STARTUP_CONCURRENCY"] == "5"
     finally:
-        RayConfig.reset("max_io_workers")
+        RayConfig.reset("maximum_startup_concurrency")
 
 
 def test_unknown_flag():

@@ -1,0 +1,515 @@
+"""Lint framework tests: per-checker fixture positives/negatives (compiled
+from strings — no repo dependence), suppression + baseline round-trips,
+reporter determinism, and the tier-1 gate itself: the full suite over
+ray_tpu/ must come back with zero non-baselined findings."""
+
+import json
+import os
+
+import pytest
+
+from ray_tpu import _lint
+from ray_tpu._lint import (
+    FileCtx,
+    Finding,
+    fingerprints,
+    lint_source,
+    load_baseline,
+    render_json,
+    run_lint,
+    save_baseline,
+)
+
+RAY_TPU_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "ray_tpu")
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# ===================================================== the tier-1 gate
+
+def test_full_tree_is_clean():
+    """Every checker over all of ray_tpu/: zero non-baselined findings.
+    New violations fail HERE, on the PR that introduces them."""
+    result = run_lint(paths=[RAY_TPU_DIR])
+    assert len(result.checkers_run) >= 5
+    msgs = "\n".join(f"{f.path}:{f.line}: [{f.rule}] {f.message}"
+                     for f in result.findings)
+    assert result.ok, f"non-baselined lint findings:\n{msgs}"
+
+
+def test_full_tree_runs_are_byte_identical():
+    a = render_json(run_lint(paths=[RAY_TPU_DIR]))
+    b = render_json(run_lint(paths=[RAY_TPU_DIR]))
+    assert a == b
+
+
+# ================================================== async-blocking
+
+def test_async_blocking_positives():
+    src = '''
+import time, subprocess
+async def handler(self):
+    time.sleep(1)
+    x = fut.result()
+    self._lock.acquire()
+    subprocess.run(["ls"])
+    y = conn.call_sync("m")
+'''
+    rules = rules_of(lint_source(src, ["async-blocking"]))
+    assert rules == ["async-blocking"] * 5
+
+
+def test_async_blocking_negatives():
+    src = '''
+import asyncio, time
+def sync_fn():
+    time.sleep(1)          # sync context: blocking is legal
+async def handler(self):
+    await asyncio.sleep(1)
+    await self._sem.acquire()            # awaited = async acquire
+    self._lock.acquire(timeout=5)        # bounded
+    self._lock.acquire(False)            # non-blocking probe
+    out = await loop.run_in_executor(None, lambda: time.sleep(1))
+    def helper():
+        return fut.result()  # nested def runs on an executor thread
+'''
+    assert lint_source(src, ["async-blocking"]) == []
+
+
+# ================================================ lock-discipline
+
+def test_lock_unguarded_write_positive_and_negative():
+    src = '''
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0          # constructor writes are exempt
+    def inc(self):
+        with self._lock:
+            self.n += 1
+    def reset(self):
+        self.n = 0          # BAD: bare write to a lock-guarded attr
+    def untracked(self):
+        self.other = 1      # never guarded anywhere: not flagged
+'''
+    findings = lint_source(src, ["lock-discipline"])
+    assert rules_of(findings) == ["lock-discipline.unguarded-write"]
+    assert "C.n" in findings[0].message
+
+
+def test_lock_order_inversion():
+    src = '''
+import threading
+class D:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+    def one(self):
+        with self.a:
+            with self.b:
+                pass
+    def two(self):
+        with self.b:
+            with self.a:
+                pass
+'''
+    findings = lint_source(src, ["lock-discipline"])
+    assert rules_of(findings) == ["lock-discipline.order"]
+
+
+def test_lock_order_consistent_is_clean():
+    src = '''
+import threading
+class D:
+    def __init__(self):
+        self.a = threading.Lock()
+        self.b = threading.Lock()
+    def one(self):
+        with self.a:
+            with self.b:
+                pass
+    def two(self):
+        with self.a:
+            with self.b:
+                pass
+'''
+    assert lint_source(src, ["lock-discipline"]) == []
+
+
+def test_blocking_call_under_lock():
+    src = '''
+import threading, time
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def slow(self):
+        with self._lock:
+            time.sleep(0.1)
+            x = conn.call_sync("m")
+    def ok(self):
+        with self._lock:
+            d = {}.get("key")        # dict .get is not ray_tpu.get
+        time.sleep(0.1)              # lock released: fine
+'''
+    findings = lint_source(src, ["lock-discipline"])
+    assert rules_of(findings) == ["lock-discipline.blocking-call"] * 2
+
+
+def test_condition_wait_under_lock_is_clean():
+    src = '''
+import threading
+class C:
+    def __init__(self):
+        self._cv = threading.Condition()
+    def waiter(self):
+        with self._cv:
+            self._cv.wait(1.0)   # releases the lock while waiting
+'''
+    assert lint_source(src, ["lock-discipline"]) == []
+
+
+def test_known_synchronized_list_silences_static_checker():
+    """The shared sync_suppressions list is the cross-link between the
+    static checker and the dynamic race detector: one entry covers both."""
+    from ray_tpu._private import sync_suppressions
+
+    src = '''
+import threading
+class CrossLinked:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def a(self):
+        with self._lock:
+            self.state = 1
+    def b(self):
+        self.state = 2
+'''
+    assert rules_of(lint_source(src, ["lock-discipline"])) \
+        == ["lock-discipline.unguarded-write"]
+    sync_suppressions.KNOWN_SYNCHRONIZED.add("CrossLinked.state")
+    try:
+        assert lint_source(src, ["lock-discipline"]) == []
+    finally:
+        sync_suppressions.KNOWN_SYNCHRONIZED.discard("CrossLinked.state")
+
+
+# ================================================== config-drift
+
+def _config_fixture():
+    return FileCtx("ray_tpu/_private/config.py", '''
+RayConfig = object()
+def _d(name, typ, default, doc=""):
+    pass
+_d("wired_flag", int, 1, "used below")
+_d("dead_flag", int, 2, "nothing reads this")
+''')
+
+
+def test_config_drift_unregistered_env_and_dead_flag():
+    user = FileCtx("ray_tpu/user.py", '''
+import os
+a = os.environ.get("RAY_TPU_NOT_A_FLAG")
+b = RayConfig.wired_flag
+''')
+    result = run_lint(files=[_config_fixture(), user],
+                      checkers=["config-drift"], baseline=None)
+    rules = sorted(rules_of(result.findings))
+    assert rules == ["config-drift.dead-flag",
+                     "config-drift.unregistered-env"]
+    by_rule = {f.rule: f for f in result.findings}
+    assert "RAY_TPU_NOT_A_FLAG" in by_rule["config-drift.unregistered-env"].message
+    assert "dead_flag" in by_rule["config-drift.dead-flag"].message
+
+
+def test_config_drift_negative_flag_env_and_allowlist():
+    user = FileCtx("ray_tpu/user.py", '''
+import os
+a = os.environ.get("RAY_TPU_WIRED_FLAG")     # maps to wired_flag
+b = os.environ.get("RAY_TPU_ADDRESS")        # allowlisted bootstrap key
+c = RayConfig.dead_flag                      # now referenced
+''')
+    result = run_lint(files=[_config_fixture(), user],
+                      checkers=["config-drift"], baseline=None)
+    assert result.findings == []
+
+
+# ============================================== collective-timeout
+
+def test_collective_timeout_def_positive_negative():
+    bad = FileCtx("ray_tpu/util/collective/collective.py", '''
+def recv(src_rank, tag=0):
+    pass
+def barrier(group_name="default", timeout_s=None):
+    pass
+''')
+    result = run_lint(files=[bad], checkers=["collective-timeout"],
+                      baseline=None)
+    assert rules_of(result.findings) == ["collective-timeout.def"]
+    assert "`recv`" in result.findings[0].message
+
+
+def test_collective_timeout_call_sites():
+    caller = FileCtx("ray_tpu/train/_session.py", '''
+from ray_tpu.util import collective
+from ray_tpu.util.collective import recv
+collective.barrier("g")                      # BAD: no defaulted def seen
+recv(0, timeout_s=5.0)                       # explicit timeout: fine
+x = {}.get("recv")                           # unrelated name: fine
+sock.recv(1024)                              # not a collective alias: fine
+''')
+    result = run_lint(files=[caller], checkers=["collective-timeout"],
+                      baseline=None)
+    assert rules_of(result.findings) == ["collective-timeout.call"]
+    assert "`barrier`" in result.findings[0].message
+
+
+def test_collective_timeout_call_inherits_module_default():
+    colmod = FileCtx("ray_tpu/util/collective/collective.py", '''
+def barrier(group_name="default", timeout_s=None):
+    pass
+''')
+    caller = FileCtx("ray_tpu/train/_session.py", '''
+from ray_tpu.util import collective
+collective.barrier("g")    # inherits the def's bounded default
+''')
+    result = run_lint(files=[colmod, caller],
+                      checkers=["collective-timeout"], baseline=None)
+    assert result.findings == []
+
+
+# ============================================== jax-tracer-hygiene
+
+def test_tracer_hygiene_positives():
+    src = '''
+import jax
+import numpy as np
+@jax.jit
+def step(x):
+    v = float(x)
+    a = np.asarray(x)
+    print("trace me")
+    return x.item()
+'''
+    rules = rules_of(lint_source(src, ["jax-tracer-hygiene"]))
+    assert rules == ["jax-tracer-hygiene"] * 4
+
+
+def test_tracer_hygiene_jit_call_assignment_and_negatives():
+    src = '''
+import jax
+import numpy as np
+
+class Trainer:
+    def __init__(self):
+        self._step = jax.jit(self._train_step)
+
+    def _train_step(self, x):
+        t = x.sum()
+        return t * np.asarray([1.0, 2.0])   # literal: trace-time constant
+
+def plain(x):
+    return float(x)       # not jitted: host code is free to coerce
+'''
+    assert lint_source(src, ["jax-tracer-hygiene"]) == []
+
+
+def test_tracer_hygiene_flags_local_jitted_method():
+    src = '''
+import jax
+
+class Trainer:
+    def __init__(self):
+        self._step = jax.jit(self._train_step)
+
+    def _train_step(self, x):
+        return float(x) + 1
+'''
+    findings = lint_source(src, ["jax-tracer-hygiene"])
+    assert rules_of(findings) == ["jax-tracer-hygiene"]
+    assert "_train_step" in findings[0].message
+
+
+def test_tracer_hygiene_other_objects_method_not_confused():
+    # jax.jit(self.actor.sample) jits the ACTOR's method — a same-named
+    # method on this class must not be flagged (rllib env-runner shape)
+    src = '''
+import jax
+import numpy as np
+
+class Runner:
+    def __init__(self):
+        self._sample = jax.jit(self.actor.sample)
+
+    def sample(self, params):
+        return np.asarray(self._sample(params))
+'''
+    assert lint_source(src, ["jax-tracer-hygiene"]) == []
+
+
+# ================================================ metrics-hygiene
+
+def test_metrics_hygiene_fixture_positives():
+    bad = FileCtx("pkg/metrics_defs.py", '''
+c = Counter("bad name", "help")
+g = Gauge("ray_tpu_prefixed", "help")
+h = Histogram("no_help", "")
+k1 = Counter("kind_clash", "a")
+k2 = Gauge("kind_clash", "b")
+''')
+    result = run_lint(files=[bad], checkers=["metrics-hygiene"],
+                      baseline=None)
+    assert sorted(rules_of(result.findings)) == [
+        "metrics-hygiene.help", "metrics-hygiene.kind",
+        "metrics-hygiene.name", "metrics-hygiene.prefix"]
+
+
+def test_metrics_hygiene_fixture_negative():
+    good = FileCtx("pkg/metrics_defs.py", '''
+c = Counter("requests_total", "requests served")
+g = Gauge("queue_depth", "queued requests")
+''')
+    result = run_lint(files=[good], checkers=["metrics-hygiene"],
+                      baseline=None)
+    assert result.findings == []
+
+
+# ======================================= suppressions and baseline
+
+def test_inline_suppression_silences_the_line():
+    src = '''
+import time
+async def handler():
+    time.sleep(1)  # lint: disable=async-blocking
+    time.sleep(2)
+'''
+    findings = lint_source(src, ["async-blocking"])
+    assert len(findings) == 1
+    assert findings[0].line == 5
+
+
+def test_file_level_suppression():
+    src = '''
+# lint: disable-file=async-blocking
+import time
+async def handler():
+    time.sleep(1)
+'''
+    assert lint_source(src, ["async-blocking"]) == []
+
+
+def test_suppression_of_sub_rule_family():
+    src = '''
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def a(self):
+        with self._lock:
+            self.x = 1
+    def b(self):
+        self.x = 2  # lint: disable=lock-discipline
+'''
+    assert lint_source(src, ["lock-discipline"]) == []
+
+
+def test_baseline_round_trip(tmp_path):
+    src = '''
+import time
+async def handler():
+    time.sleep(1)
+'''
+    ctx = FileCtx("pkg/mod.py", src)
+    fresh = run_lint(files=[ctx], checkers=["async-blocking"], baseline=None)
+    assert len(fresh.findings) == 1
+
+    path = str(tmp_path / "baseline.json")
+    save_baseline(path, fresh.findings, notes={})
+    entries = load_baseline(path)
+    assert len(entries) == 1
+
+    again = run_lint(files=[FileCtx("pkg/mod.py", src)],
+                     checkers=["async-blocking"], baseline=path)
+    assert again.findings == []
+    assert len(again.baselined) == 1
+    assert again.ok
+
+    # a NEW second violation is not absorbed by the old baseline
+    src2 = src + "    time.sleep(2)\n"
+    third = run_lint(files=[FileCtx("pkg/mod.py", src2)],
+                     checkers=["async-blocking"], baseline=path)
+    assert len(third.findings) == 1
+    assert len(third.baselined) == 1
+
+
+def test_baseline_fingerprints_survive_line_drift(tmp_path):
+    """Inserting unrelated lines above a grandfathered finding must not
+    un-baseline it (fingerprints hash no line numbers)."""
+    src = "import time\nasync def f():\n    time.sleep(1)\n"
+    path = str(tmp_path / "b.json")
+    first = run_lint(files=[FileCtx("m.py", src)],
+                     checkers=["async-blocking"], baseline=None)
+    save_baseline(path, first.findings)
+    shifted = "import time\n\n\n# comment\nasync def f():\n    time.sleep(1)\n"
+    again = run_lint(files=[FileCtx("m.py", shifted)],
+                     checkers=["async-blocking"], baseline=path)
+    assert again.findings == []
+    assert len(again.baselined) == 1
+
+
+def test_duplicate_findings_fingerprint_distinctly():
+    src = "import time\nasync def f():\n    time.sleep(1)\n    time.sleep(1)\n"
+    findings = lint_source(src, ["async-blocking"])
+    assert len(findings) == 2
+    fps = fingerprints(findings)
+    assert len(set(fps)) == 2
+
+
+def test_checked_in_baseline_is_loadable():
+    entries = load_baseline(_lint.DEFAULT_BASELINE)
+    assert isinstance(entries, dict)
+
+
+# ================================================== cli plumbing
+
+def test_cli_lint_clean_tree_exits_zero(capsys):
+    from ray_tpu.scripts.cli import main
+
+    rc = main(["lint", RAY_TPU_DIR])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 finding(s)" in out
+
+
+def test_cli_lint_json_and_nonzero_exit(tmp_path, capsys):
+    from ray_tpu.scripts.cli import main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nasync def f():\n    time.sleep(1)\n")
+    rc = main(["lint", str(bad), "--json", "--no-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    payload = json.loads(out)
+    assert payload["ok"] is False
+    assert payload["findings"][0]["rule"] == "async-blocking"
+
+
+def test_cli_list_rules(capsys):
+    from ray_tpu.scripts.cli import main
+
+    rc = main(["lint", "--list-rules"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    for name in ("async-blocking", "lock-discipline", "config-drift",
+                 "collective-timeout", "jax-tracer-hygiene",
+                 "metrics-hygiene"):
+        assert name in out
+
+
+def test_unknown_checker_rejected():
+    with pytest.raises(ValueError, match="unknown checker"):
+        run_lint(files=[FileCtx("m.py", "x = 1\n")],
+                 checkers=["no-such-rule"], baseline=None)
