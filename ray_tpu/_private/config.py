@@ -98,12 +98,17 @@ _d("idle_worker_killing_time_ms", int, 300_000, "idle worker reap delay")
 
 # --- Scheduler ---
 _d("scheduler_spread_threshold", float, 0.5, "hybrid policy: pack below this utilization, then spread")
+_d("lease_cache_idle_s", float, 2.0, "a drained scheduling class keeps its worker leases warm this long (so the next burst skips the lease round trip); nodelet reclaim hints cut it short under resource pressure")
 _d("max_pending_lease_requests_per_scheduling_category", int, 10, "pipelined lease requests")
 _d("lease_pipeline_depth", int, 48, "in-flight tasks per leased worker (exec queue serializes)")
 _d("worker_exec_threads", int, 12, "executor threads per worker (chunks share threads, so this can be < pipeline depth)")
 
 # --- Object store ---
 _d("object_store_memory_bytes", int, 2 * 1024**3, "default per-node shm store capacity")
+_d("arena_enabled", bool, True, "pre-faulted slab arena for local plasma puts (fused put/seal over bulk extent leases); off = per-object create/seal round trips")
+_d("arena_slab_bytes", int, 64 * 1024**2, "arena slab size; a larger object gets a dedicated slab of its own size")
+_d("extent_lease_bytes", int, 16 * 1024**2, "extra extent bytes a client leases beyond the current put, so steady-state puts skip the lease RPC")
+_d("extent_lease_idle_s", float, 10.0, "clients return unused leased extents after this idle time")
 _d("max_direct_call_object_size", int, 100 * 1024, "objects <= this are inlined in the owner memory store")
 _d("object_store_full_delay_ms", int, 100, "retry delay when store is full")
 _d("object_transfer_inflight_bytes", int, 32 * 1024 * 1024, "max in-flight bytes per object pull")

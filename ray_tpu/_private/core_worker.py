@@ -274,6 +274,7 @@ class CoreWorker:
         self._task_events: deque = deque(
             maxlen=RayConfig.task_events_max_buffer_size)
         self._flush_scheduled = False
+        self._last_event_flush = 0.0
         self._shut = False  # must exist before the flush loop's first check
         if RayConfig.task_events_enabled:
             self.io.spawn(self._flush_task_events_loop())
@@ -356,7 +357,19 @@ class CoreWorker:
         self._task_events.append(ev)
         if terminal and not self._flush_scheduled:
             self._flush_scheduled = True
-            self.io.spawn(self._flush_task_events_once())
+            # Throttle, don't debounce: an isolated terminal event flushes
+            # NOW (a read right after a task completes must see it); during
+            # a completion storm later flushes wait out the interval, so a
+            # sync-call loop batches ~dozens of events per GCS frame
+            # instead of one frame + one GCS wakeup per task.
+            delay = max(
+                0.0, self._last_event_flush + 0.02 - time.monotonic())
+            coro = self._flush_task_events_once(delay)
+            try:
+                self.io.spawn(coro)
+            except RuntimeError:  # loop closed: shutdown path
+                coro.close()
+                self._flush_scheduled = False
 
     def _observe_phases(self, spec: TaskSpec, item: dict) -> None:
         """Fold the driver's and executor's phase stamps into per-phase
@@ -415,7 +428,7 @@ class CoreWorker:
         while not self._shut:
             await asyncio.sleep(interval)
             try:
-                await self.nodelet_conn.notify("metrics_push", {
+                self.nodelet_conn.notify_coalesced("metrics_push", {
                     "source": source,
                     "snapshot": default_registry.snapshot()})
             except (ConnectionError, rpc.ConnectionLost):
@@ -439,8 +452,11 @@ class CoreWorker:
             await asyncio.sleep(interval)
             await self._flush_task_events()
 
-    async def _flush_task_events_once(self):
+    async def _flush_task_events_once(self, delay: float = 0.0):
+        if delay > 0:
+            await asyncio.sleep(delay)
         self._flush_scheduled = False
+        self._last_event_flush = time.monotonic()
         await self._flush_task_events()
 
     async def _flush_task_events(self):
@@ -487,6 +503,12 @@ class CoreWorker:
         self._shut = True
         try:  # last task events would otherwise be lost with the process
             self.io.run(self._flush_task_events(), timeout=2)
+        except Exception:
+            pass
+        try:
+            # flush coalesced plasma releases + return leased extents so the
+            # store's accounting is exact even before conn-loss cleanup runs
+            self.plasma.close()
         except Exception:
             pass
         try:
@@ -648,11 +670,14 @@ class CoreWorker:
 
     def _resolve_one(self, ref: ObjectRef, deadline=None) -> Any:
         oid = ref.oid
-        # 1. The in-process memory store (owned objects & cached borrows).
-        if self.memory_store.known(oid):
-            if not self.memory_store.wait_ready(oid, self._remaining(deadline)):
-                raise GetTimeoutError(f"object {oid.hex()} not ready within timeout")
-            ok, value, err = self.memory_store.get_if_ready(oid)
+        # 1. The in-process memory store (owned objects & cached borrows):
+        # one lock acquisition resolves the common already-ready case.
+        known, ready, value, err = self.memory_store.try_get(oid)
+        if known:
+            if not ready:
+                if not self.memory_store.wait_ready(oid, self._remaining(deadline)):
+                    raise GetTimeoutError(f"object {oid.hex()} not ready within timeout")
+                ok, value, err = self.memory_store.get_if_ready(oid)
             if err is not None:
                 raise err
             if value is IN_PLASMA:
@@ -697,7 +722,13 @@ class CoreWorker:
             round_timeout = quick if rem is None else min(quick, rem)
             mv = self.plasma.get_mapped(oid, round_timeout)
             if mv is not None:
-                return self.ctx.deserialize(SerializedObject.from_buffer(mv))
+                ser = SerializedObject.from_buffer(mv)
+                # hand deserialization refcount-probeable view handles: the
+                # client defers the server-side pin release until no live
+                # view remains (arena extents must not be reused under a
+                # deserialized numpy array)
+                ser.buffers = self.plasma.wrap_views(oid, ser.buffers)
+                return self.ctx.deserialize(ser)
             # A reconstruction may have resolved through the MEMORY store
             # instead of plasma (the re-run errored, or returned small this
             # time): plasma polling alone would never see it.
@@ -934,8 +965,7 @@ class CoreWorker:
                 return
             if self._shut:
                 return
-            self.ref_counter.remove_local(oid)
-            if not self.ref_counter.has(oid):
+            if not self.ref_counter.remove_local(oid):
                 self.plasma.release(oid)
                 if owner is not None and owner != self.worker_id.binary():
                     # Borrowed value cached by _resolve_one: drop with the
@@ -957,8 +987,17 @@ class CoreWorker:
             self._recovery_attempts.pop(oid, None)
         del contained  # dropping the ObjectRefs decrements their counts
         if in_plasma and not self._shut:
+            # local fast path first: the nearby store's capacity frees on the
+            # next loop tick (coalesced notify) instead of waiting out the
+            # seal->directory->GCS->broadcast round trip; the GCS free still
+            # sweeps remote copies and the directory.
             try:
-                self.io.spawn(self.gcs_conn.notify("free_objects", {"oids": [oid.binary()]}))
+                self.plasma.free_async([oid])
+            except Exception:
+                pass
+            try:
+                self.gcs_conn.notify_coalesced_threadsafe(
+                    "free_objects", {"oids": [oid.binary()]})
             except Exception:
                 pass
 
@@ -968,7 +1007,9 @@ class CoreWorker:
         async def _go():
             try:
                 conn = await self._owner_conn_async(tuple(owner_addr))
-                await conn.notify("ref_borrow", {
+                # borrow-count updates are pure control noise on the hot
+                # path: ride the per-tick coalesced batch frame
+                conn.notify_coalesced("ref_borrow", {
                     "action": action, "oid": oid.binary(),
                     "borrower": self.worker_id.binary(),
                 })
@@ -1052,6 +1093,18 @@ class CoreWorker:
 
     async def rpc_ping(self, conn, msg):
         return {"worker_id": self.worker_id.binary(), "pid": os.getpid()}
+
+    async def rpc_lease_reclaim(self, conn, msg):
+        """Nodelet hint: a lease request / bundle reservation is queued
+        behind resources our cached idle leases hold — return them now."""
+        await self.submitter.return_cached_leases()
+        return True
+
+    async def rpc_extent_reclaim(self, conn, msg):
+        """Nodelet hint: the store hit full during an extent lease — hand
+        back idle leased extents so the requester's retry succeeds."""
+        self.plasma.return_idle_extents(force=True)
+        return True
 
     # ----------------------------------------------- live introspection
     def _track_task_start(self, spec: TaskSpec, thread_ident) -> None:
@@ -2342,6 +2395,16 @@ class NormalTaskSubmitter:
         self._stage: deque = deque()
         self._stage_lock = threading.Lock()
         self._stage_scheduled = False
+        # Lease cache: dispatches served by an already-held (warm) lease vs
+        # leases requested from the nodelet — the measure of how often the
+        # hot path skips the per-task lease round trip.
+        from ray_tpu._private.metrics import Counter
+
+        self._m_lease_cache = Counter(
+            "lease_cache_hits",
+            "task dispatches onto an already-held worker lease")
+        self._m_lease_requests = Counter(
+            "lease_requests", "worker-lease requests sent to a nodelet")
 
     # ------------------------------------------------------- staged enqueue
     def enqueue(self, spec: TaskSpec, holds) -> None:
@@ -2490,10 +2553,59 @@ class NormalTaskSubmitter:
         want = min(effective, max_pending) - st["inflight"]
         for _ in range(max(want, 0)):
             st["inflight"] += 1
+            self._m_lease_requests.inc()
             asyncio.get_event_loop().create_task(self._request_lease(key, st))
         if not st["pending"]:
             self._cancel_outstanding_leases(st)
             if not st["busy"]:
+                # Lease cache: don't return the workers the moment the queue
+                # drains — the next `.remote()` burst (sync-call loops drain
+                # after EVERY task) reuses the warm lease with zero nodelet
+                # round trips.  The idle timer (or a nodelet reclaim hint
+                # when someone queues on the held resources) frees them.
+                self._schedule_idle_return(key, st)
+
+    def _schedule_idle_return(self, key, st) -> None:
+        """Arm (or re-arm) the cached-lease expiry for a drained class."""
+        st["drained_at"] = time.monotonic()
+        if st.get("idle_timer") or self.cw._shut:
+            return
+        st["idle_timer"] = True
+        try:
+            asyncio.get_event_loop().create_task(
+                self._idle_return_timer(key, st))
+        except RuntimeError:  # loop tearing down: leases die with the conn
+            st["idle_timer"] = False
+
+    async def _idle_return_timer(self, key, st) -> None:
+        try:
+            while True:
+                drained = st.get("drained_at")
+                if drained is None:
+                    return  # new work arrived: the cache is earning its keep
+                wait = drained + RayConfig.lease_cache_idle_s - time.monotonic()
+                if wait > 0:
+                    await asyncio.sleep(wait)
+                    continue
+                if not st["pending"] and not st["busy"]:
+                    await self._return_idle(st)
+                    st["drained_at"] = None
+                return
+        finally:
+            st["idle_timer"] = False
+            # re-arm if the class drained again while we were returning
+            if st.get("drained_at") is not None and not st["pending"] \
+                    and not st["busy"] and st["idle"] \
+                    and not st.get("idle_timer"):
+                self._schedule_idle_return(key, st)
+
+    async def return_cached_leases(self) -> None:
+        """Nodelet reclaim hint: something is queued behind resources our
+        cached idle leases hold — hand every drained class's leases back
+        now instead of waiting out the idle timer."""
+        for key, st in list(self.classes.items()):
+            if not st["pending"] and not st["busy"]:
+                st["drained_at"] = None
                 await self._return_idle(st)
 
     def _cancel_outstanding_leases(self, st) -> None:
@@ -2693,6 +2805,8 @@ class NormalTaskSubmitter:
     # which capped async task throughput at ~11% of the reference baseline.
     def _queue_push(self, key, st, spec: TaskSpec, holds, lease) -> None:
         st["busy"] += 1
+        st["drained_at"] = None  # the lease cache is live again
+        self._m_lease_cache.inc()
         buf = lease.get("outbuf")
         if buf is None:
             lease["outbuf"] = [(spec, holds)]

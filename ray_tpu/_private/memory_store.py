@@ -75,6 +75,18 @@ class MemoryStore:
                 return False, None, None
             return True, e.value, e.error
 
+    def try_get(self, oid: ObjectID) -> Tuple[bool, bool, Any, Optional[BaseException]]:
+        """(known, ready, value, error) in ONE lock acquisition — the
+        ray.get fast path previously paid three (known -> wait_ready ->
+        get_if_ready) per resolved object."""
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None:
+                return False, False, None, None
+            if not e.ready:
+                return True, False, None, None
+            return True, True, e.value, e.error
+
     def wait_ready(self, oid: ObjectID, timeout: Optional[float]) -> bool:
         """Block the calling (user) thread until the object resolves."""
         with self._lock:

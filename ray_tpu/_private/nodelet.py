@@ -147,7 +147,9 @@ class Nodelet:
         self._infeasible_demand: Dict[tuple, tuple] = {}
 
         handlers = {}
-        register_store_handlers(handlers, self.store, self.waiters, on_miss=self._on_store_miss)
+        register_store_handlers(handlers, self.store, self.waiters,
+                                on_miss=self._on_store_miss,
+                                on_full=self._broadcast_extent_reclaim)
         for name in dir(self):
             if name.startswith("rpc_"):
                 handlers[name[4:]] = getattr(self, name)
@@ -390,6 +392,9 @@ class Nodelet:
                 "object_store_objects", "local objects")
             self._m_store_capacity = M.Gauge(
                 "object_store_capacity_bytes", "plasma capacity")
+            self._m_store_arena = M.Gauge(
+                "object_store_arena_bytes",
+                "pre-faulted arena slab bytes (live + leased + free)")
             self._m_mem_used = M.Gauge(
                 "node_mem_used_bytes", "host memory in use")
             self._m_mem_total = M.Gauge(
@@ -407,6 +412,7 @@ class Nodelet:
         self._m_store_objects.set(st.get("num_objects", len(self.store.objects)),
                                   {"node": nid})
         self._m_store_capacity.set(self.store.capacity, {"node": nid})
+        self._m_store_arena.set(st.get("arena_bytes", 0), {"node": nid})
         from ray_tpu._private.memory_monitor import _read_meminfo
 
         mem = _read_meminfo()
@@ -1087,6 +1093,11 @@ class Nodelet:
         from ray_tpu._private.object_store import cleanup_client_connection
 
         cleanup_client_connection(self.store, conn)
+        # leases granted to a vanished client (driver death, cached leases
+        # included): the workers are healthy — return them to the idle pool
+        # instead of stranding them in "leased" forever
+        for lease_id in conn.context.pop("granted_leases", set()):
+            self._release_lease(lease_id)
         wid = conn.context.get("worker_id")
         if wid is not None and not self._shutting_down:
             w = self.workers.get(wid)
@@ -1340,6 +1351,9 @@ class Nodelet:
             self._queued_leases.append((resources, bundle, fut))
             if token:
                 self._lease_waiters[token] = fut
+            # the capacity we're queueing on may be held by drivers' cached
+            # idle leases: ask them to give the warm workers back
+            self._hint_lease_reclaim()
             try:
                 await fut  # resources are acquired by _pump_queued_leases
             except _LeaseCancelled:
@@ -1376,6 +1390,9 @@ class Nodelet:
         lease_id = self._lease_seq
         w.lease_id = lease_id
         self.leases[lease_id] = {"resources": resources, "bundle": bundle, "worker": w}
+        # remember who holds it: conn loss returns the lease (a dead driver's
+        # cached leases must not strand healthy workers in "leased")
+        conn.context.setdefault("granted_leases", set()).add(lease_id)
         self._observe_lease_phases(t_req, t_acquired, time.monotonic())
         return {"type": "granted", "lease_id": lease_id,
                 "worker_addr": list(w.addr), "worker_id": w.worker_id}
@@ -1425,8 +1442,39 @@ class Nodelet:
         self._pump_queued_leases()
 
     async def rpc_return_worker(self, conn, msg):
+        conn.context.get("granted_leases", set()).discard(msg["lease_id"])
         self._release_lease(msg["lease_id"])
         return True
+
+    # ---------------------------------------------------- reclaim hints
+    def _hint_lease_reclaim(self) -> None:
+        """Ask clients with cached idle leases to return them: a lease /
+        bundle reservation is queued behind resources they hold.  Throttled;
+        fire-and-forget over the coalesced batch."""
+        now = time.monotonic()
+        if now - getattr(self, "_last_lease_hint", 0.0) < 0.5:
+            return
+        self._last_lease_hint = now
+        for conn in list(self.server.connections):
+            if conn.context.get("granted_leases"):
+                try:
+                    conn.notify_coalesced("lease_reclaim", None)
+                except ConnectionError:
+                    pass
+
+    def _broadcast_extent_reclaim(self) -> None:
+        """Store hit full during an extent lease: ask clients to hand back
+        idle leased extents before the requester's retry."""
+        now = time.monotonic()
+        if now - getattr(self, "_last_extent_hint", 0.0) < 0.2:
+            return
+        self._last_extent_hint = now
+        for conn in list(self.server.connections):
+            if conn.context.get("plasma_extents"):
+                try:
+                    conn.notify_coalesced("extent_reclaim", None)
+                except ConnectionError:
+                    pass
 
     async def rpc_set_env(self, conn, msg):
         """Fault-injection hook for chaos tests (fake disk usage, fake
@@ -1466,6 +1514,7 @@ class Nodelet:
                 return {"ok": False, "reason": "infeasible"}
             fut = asyncio.get_event_loop().create_future()
             self._queued_leases.append((spec.resources, bundle, fut))
+            self._hint_lease_reclaim()
             try:
                 await asyncio.wait_for(fut, RayConfig.gcs_rpc_timeout_s * 0.8)
             except asyncio.TimeoutError:
@@ -1525,7 +1574,14 @@ class Nodelet:
         resources = msg["resources"]
         if not all(self.resources_available.get(k, 0.0) >= v
                    for k, v in resources.items() if v > 0):
-            return False
+            # the shortfall may be drivers' cached idle leases: hint, give
+            # them one beat to come back, recheck (the GCS retries a failed
+            # prepare, so this only shortens the failure window)
+            self._hint_lease_reclaim()
+            await asyncio.sleep(0.25)
+            if not all(self.resources_available.get(k, 0.0) >= v
+                       for k, v in resources.items() if v > 0):
+                return False
         for k, v in resources.items():
             self.resources_available[k] = self.resources_available.get(k, 0.0) - v
         self.bundles[key] = Bundle(msg["pg_id"], msg["index"], resources)

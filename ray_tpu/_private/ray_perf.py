@@ -193,11 +193,55 @@ def run_microbenchmarks(duration_s: float = 2.0,
         results["single_client_put_vs_memcpy_ceiling"] = \
             results["single_client_put_gigabytes"] / ceiling
 
+    # ------------------------------------- put-bandwidth sweep across sizes
+    # One row per size (64 KiB -> 256 MiB) so a BENCH_*.json diff attributes
+    # a bandwidth change to the size class it came from (small puts measure
+    # control-plane cost, large ones memcpy + arena behavior).
+    sweep: Dict[str, float] = {}
+    for size in (64 * 1024, 1024**2, 8 * 1024**2, 64 * 1024**2,
+                 256 * 1024**2):
+        data = np.random.default_rng(1).integers(0, 255, size, dtype=np.uint8)
+        win: list = []
+        keep = 3 if size <= 64 * 1024**2 else 1
+
+        def put_one():
+            win.append(ray_tpu.put(data))
+            if len(win) > keep:
+                win.pop(0)
+            return 1
+
+        try:
+            per_s = _rate(put_one, min(duration_s, 1.0))
+        except Exception:  # a size class over capacity must not kill the run
+            continue
+        finally:
+            win.clear()
+        label = f"{size // 1024}KiB" if size < 1024**2 else f"{size // 1024**2}MiB"
+        sweep[label] = round(per_s * size / 1024**3, 3)
+        _settle(0.2)
+    results["put_bandwidth_sweep_gigabytes"] = sweep
+
+    # ------------------------------------------------- phase-clock fold-in
+    # p50 per hot-path phase from the PR 1 phase clock, so each
+    # optimization's effect is attributable to the phase it moved
+    # (driver_serialize / driver_stage / dispatch / exec / result_put /
+    # result_wake).
+    try:
+        time.sleep(1.0)  # let the last completions' PHASES events flush
+        from ray_tpu.util import state as _state
+
+        phases = _state.summarize_task_phases()
+        results["phase_p50_ms"] = {
+            k: round(v["p50"] * 1e3, 3) for k, v in phases.items()}
+    except Exception:
+        pass  # observability must never fail the bench
+
     results_vs = {
         f"{k}_vs_baseline": round(v / BASELINE[k], 4)
         for k, v in results.items() if k in BASELINE
     }
-    results = {k: round(v, 2) for k, v in results.items()}
+    results = {k: (round(v, 2) if isinstance(v, float) else v)
+               for k, v in results.items()}
     results.update(results_vs)
     return results
 

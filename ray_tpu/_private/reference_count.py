@@ -94,13 +94,16 @@ class ReferenceCounter:
         if notify:
             self._notify_owner(owner_addr, "add", oid)
 
-    def remove_local(self, oid: ObjectID) -> None:
+    def remove_local(self, oid: ObjectID) -> bool:
+        """Drop one local hold.  Returns True while the ref is still
+        tracked afterwards — callers previously paid a second lock
+        acquisition (``has``) per release to learn this."""
         cb = None
         notify_addr = None
         with self._lock:
             r = self._refs.get(oid)
             if r is None:
-                return
+                return False
             r.local -= 1
             if r.local <= 0 and r.submitted <= 0:
                 if r.owned:
@@ -108,10 +111,12 @@ class ReferenceCounter:
                 else:
                     notify_addr = r.owner_addr
                     del self._refs[oid]
+            present = oid in self._refs
         if cb:
             cb()
         if notify_addr is not None:
             self._notify_owner(notify_addr, "remove", oid)
+        return present
 
     def add_submitted(self, oid: ObjectID) -> None:
         with self._lock:

@@ -63,9 +63,18 @@ T_REQ, T_RES, T_ERR, T_NOTIFY, T_HELLO = 0, 1, 2, 3, 4
 PROTOCOL_VERSION = 1
 MIN_COMPATIBLE_VERSION = 1
 PROTOCOL_FEATURES = ("pickle5-oob", "batched-tasks", "chunked-pull",
-                     "task-events", "dag-channels")
+                     "task-events", "dag-channels", "rpc-batch")
 
 _OOB_THRESHOLD = 64 * 1024  # RPC-level threshold for out-of-band buffers
+
+# Messages whose encoded payload exceeds this ride their own frame instead
+# of the per-tick batch: coalescing exists to amortize syscalls over SMALL
+# control messages (seals, releases, ref-count updates), and batching a big
+# payload would just add one memcpy in front of the same socket write.
+_BATCH_INBAND_MAX = 32 * 1024
+# The per-tick batch frame's method name.  Items are (t, id, method, inband)
+# tuples; the receiver dispatches them in order inside one task.
+_BATCH_METHOD = "__batch__"
 
 Handler = Callable[["Connection", Any], Awaitable[Any]]
 
@@ -119,6 +128,12 @@ class Connection:
         self._id_gen = itertools.count(1)
         self._pending: Dict[int, asyncio.Future] = {}
         self._dispatch_tasks: set = set()
+        # Per-tick coalescing buffer: (t, id, method, inband) items flushed
+        # as ONE __batch__ frame by a call_soon callback — N small control
+        # messages cost one syscall instead of N (see notify_coalesced /
+        # call_pipelined).
+        self._obuf: list = []
+        self._obuf_scheduled = False
         self._closed = False
         self._loop = asyncio.get_event_loop()
         self._send_lock = asyncio.Lock()
@@ -214,6 +229,134 @@ class Connection:
         fut = asyncio.run_coroutine_threadsafe(self.notify(method, obj), self._loop)
         return fut.result(timeout)
 
+    # ------------------------------------------------ coalesced control plane
+    # Small control frames (seal/release/ref-count/metric/event pushes) were
+    # one frame + one syscall + often one round trip EACH; on a shared-core
+    # host the per-frame cost dominates the control plane.  The batch layer
+    # buffers items for one loop tick and ships them as a single __batch__
+    # frame; the receiver dispatches them in order inside one task.
+
+    def notify_coalesced(self, method: str, obj: Any = None) -> None:
+        """Fire-and-forget notify riding the per-tick batch frame.  MUST be
+        called from the IO-loop thread (use notify_coalesced_threadsafe
+        elsewhere).  Large/out-of-band payloads fall back to a plain notify
+        frame."""
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} is closed")
+        inband, buffers = _encode(obj)
+        if buffers or len(inband) > _BATCH_INBAND_MAX:
+            self._spawn_task(self._notify_quietly(method, inband, buffers))
+            return
+        self._queue_batch_item(T_NOTIFY, 0, method, inband)
+
+    def notify_coalesced_threadsafe(self, method: str, obj: Any = None) -> None:
+        """notify_coalesced from any thread: the payload is encoded on the
+        caller's thread (keeping pickling off the IO loop) and the queue
+        append hops to the loop."""
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} is closed")
+        inband, buffers = _encode(obj)
+        try:
+            if buffers or len(inband) > _BATCH_INBAND_MAX:
+                self._loop.call_soon_threadsafe(
+                    self._spawn_task,
+                    self._notify_quietly(method, inband, buffers))
+            else:
+                self._loop.call_soon_threadsafe(
+                    self._queue_batch_item, T_NOTIFY, 0, method, inband)
+        except RuntimeError:
+            pass  # loop closed: shutdown path, drop like a lost notify
+
+    async def _notify_quietly(self, method: str, inband: bytes, buffers: list):
+        try:
+            await self._send_frame(
+                {"t": T_NOTIFY, "id": 0, "m": method, "nbufs": len(buffers)},
+                inband, buffers)
+        except (ConnectionError, OSError):
+            pass  # fire-and-forget semantics match notify-on-dead-peer
+
+    async def call_pipelined(self, method: str, obj: Any = None,
+                             timeout: Optional[float] = None) -> Any:
+        """Like call(), but the request frame rides the per-tick batch, so N
+        same-tick requests cost one write.  For small, fast handlers only —
+        batched requests are dispatched sequentially on the receiver."""
+        if self._closed:
+            raise ConnectionLost(f"connection {self.name} is closed")
+        inband, buffers = _encode(obj)
+        if buffers or len(inband) > _BATCH_INBAND_MAX:
+            return await self.call(method, obj, timeout)
+        req_id = next(self._id_gen)
+        fut = self._loop.create_future()
+        self._pending[req_id] = fut
+        self._queue_batch_item(T_REQ, req_id, method, inband)
+        try:
+            if timeout is not None:
+                return await asyncio.wait_for(fut, timeout)
+            return await fut
+        finally:
+            self._pending.pop(req_id, None)
+
+    def _queue_batch_item(self, t: int, rid: int, method: str,
+                          inband: bytes) -> None:
+        if self._closed:
+            return  # pending REQ futures are failed by _shutdown
+        self._obuf.append((t, rid, method, inband))
+        if not self._obuf_scheduled:
+            self._obuf_scheduled = True
+            self._loop.call_soon(self._flush_obuf)
+
+    def _flush_obuf(self) -> None:
+        self._obuf_scheduled = False
+        if not self._obuf:
+            return
+        items, self._obuf = self._obuf, []
+        if self._closed:
+            return
+        self._spawn_task(self._send_batch(items))
+
+    async def _send_batch(self, items: list) -> None:
+        inband, buffers = _encode(items)
+        try:
+            await self._send_frame(
+                {"t": T_NOTIFY, "id": 0, "m": _BATCH_METHOD,
+                 "nbufs": len(buffers)}, inband, buffers)
+        except (ConnectionError, OSError) as e:
+            # REQ items' futures are registered in _pending: fail them like
+            # a lost connection would (the recv loop may not notice yet).
+            for t, rid, _m, _b in items:
+                if t == T_REQ:
+                    fut = self._pending.pop(rid, None)
+                    if fut is not None and not fut.done():
+                        fut.set_exception(ConnectionLost(str(e)))
+
+    async def _dispatch_batch(self, items: list) -> None:
+        """Receiver side of the batch frame: items run in order, one task."""
+        for item in items:
+            try:
+                t, rid, method, inband = item
+                obj = pickle.loads(inband)
+            except Exception as decode_err:
+                self._handle_decode_error(
+                    {"id": item[1] if len(item) > 1 else 0,
+                     "m": item[2] if len(item) > 2 else "?"},
+                    item[0] if item else T_NOTIFY, decode_err)
+                continue
+            if t == T_REQ:
+                await self._dispatch({"t": t, "id": rid, "m": method}, obj)
+            elif t == T_NOTIFY:
+                await self._dispatch({"t": t, "id": 0, "m": method}, obj,
+                                     needs_reply=False)
+            elif t in (T_RES, T_ERR):
+                fut = self._pending.pop(rid, None)
+                if fut is not None and not fut.done():
+                    if t == T_RES:
+                        fut.set_result(obj)
+                    elif isinstance(obj, BaseException):
+                        fut.set_exception(obj)
+                    else:
+                        fut.set_exception(RaySerializationError(
+                            f"malformed error reply: {obj!r}"))
+
     async def _read_exactly(self, n: int) -> bytes:
         return await self._reader.readexactly(n)
 
@@ -238,7 +381,12 @@ class Connection:
                 if t == T_HELLO:
                     self._on_hello(header)
                     continue
-                if t == T_REQ:
+                if t == T_NOTIFY and header.get("m") == _BATCH_METHOD:
+                    # coalesced control frame: dispatch items in order
+                    # inside ONE task (an asyncio task per item would
+                    # recreate the overhead batching removes)
+                    self._spawn_task(self._dispatch_batch(obj))
+                elif t == T_REQ:
                     self._spawn_dispatch(header, obj)
                 elif t == T_NOTIFY:
                     self._spawn_dispatch(header, obj, needs_reply=False)
@@ -381,7 +529,13 @@ class Connection:
             if needs_reply:
                 if error is None:
                     inband, buffers = _encode(result)
-                    await self._send_frame({"t": T_RES, "id": header["id"], "m": method, "nbufs": len(buffers)}, inband, buffers)
+                    if not buffers and len(inband) <= _BATCH_INBAND_MAX:
+                        # small reply: ride the per-tick batch so a burst of
+                        # same-tick completions answers in one frame
+                        self._queue_batch_item(
+                            T_RES, header["id"], method, inband)
+                    else:
+                        await self._send_frame({"t": T_RES, "id": header["id"], "m": method, "nbufs": len(buffers)}, inband, buffers)
                 elif not self._closed:
                     try:
                         inband, buffers = _encode(error)
@@ -406,6 +560,7 @@ class Connection:
         if self._closed:
             return
         self._closed = True
+        self._obuf.clear()  # queued REQ items fail via the pending sweep
         for fut in list(self._pending.values()):
             if not fut.done():
                 fut.set_exception(ConnectionLost(f"connection {self.name} lost"))

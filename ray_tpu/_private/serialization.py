@@ -65,6 +65,44 @@ class SerializedObject:
         self.write_into(out)
         return bytes(out)
 
+    def iter_frame(self, chunk_bytes: int):
+        """Yield the flattened frame as a sequence of chunks, each at most
+        ``chunk_bytes``, WITHOUT materializing the whole frame: large
+        buffers are sliced in place, only sub-chunk header/length pieces are
+        stitched together.  Streaming consumers (a ray:// driver shipping a
+        multi-GiB put over RPC) stay at one-chunk peak memory instead of
+        2x the payload."""
+        assert chunk_bytes > 0
+        pending = bytearray()
+
+        def pieces():
+            yield len(self.buffers).to_bytes(4, "little")
+            yield len(self.inband).to_bytes(8, "little")
+            yield self.inband
+            for b in self.buffers:
+                yield b.nbytes.to_bytes(8, "little")
+                flat = b if getattr(b, "ndim", 1) == 1 and \
+                    getattr(b, "format", "B") == "B" else b.cast("B")
+                yield flat
+
+        for piece in pieces():
+            mv = memoryview(piece) if not isinstance(piece, memoryview) \
+                else piece
+            off = 0
+            while off < mv.nbytes:
+                take = min(chunk_bytes - len(pending), mv.nbytes - off)
+                if not pending and take == chunk_bytes:
+                    # full chunk straight out of the source: zero-copy slice
+                    yield mv[off:off + take]
+                else:
+                    pending.extend(mv[off:off + take])
+                    if len(pending) == chunk_bytes:
+                        yield memoryview(bytes(pending))
+                        pending.clear()
+                off += take
+        if pending:
+            yield memoryview(bytes(pending))
+
     @classmethod
     def from_buffer(cls, buf) -> "SerializedObject":
         """Parse a flattened frame, keeping buffers as zero-copy memoryviews."""

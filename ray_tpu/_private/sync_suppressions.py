@@ -20,4 +20,9 @@ KNOWN_SYNCHRONIZED = {
     "ServeReplica._ongoing",
     "ServeReplica._total",
     "ServeReplica._streams",
+    # object_store.py PlasmaClient: _evict_write_cache_locked follows the
+    # "_locked" suffix convention — every caller already holds
+    # _write_lock (the static checker analyzes one method at a time and
+    # cannot see the callers' `with self._write_lock:` frames).
+    "PlasmaClient._write_cache_bytes",
 }

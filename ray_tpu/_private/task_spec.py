@@ -135,5 +135,9 @@ class TaskSpec:
             s.node_id,
             s.placement_group_id,
             s.placement_group_bundle_index,
+            # distinct label selectors must not share leases: a worker
+            # granted for {tier: tpu} lives on a node a {zone: us-a}
+            # task may not target (lease caching widened this window)
+            repr(s.label_selector) if s.label_selector else None,
             self.runtime_env is not None and repr(sorted(self.runtime_env.items())),
         )

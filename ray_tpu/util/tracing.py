@@ -143,6 +143,18 @@ def export_otlp(filename: str, trace_id: Optional[str] = None,
     """
     from ray_tpu.util import state
 
+    # Read-your-writes: the local driver's event buffer flushes on a small
+    # throttle; an export issued right after a span closes must still see
+    # it, so force this process's buffer to the GCS first.
+    from ray_tpu._private import worker as _worker_mod
+
+    core = _worker_mod.global_worker_core()
+    if core is not None:
+        try:
+            core.io.run(core._flush_task_events(), timeout=2)
+        except Exception:
+            pass  # export proceeds on whatever has landed
+
     rows = state.list_tasks(limit=100_000)
     spans: List[Dict[str, Any]] = []
     for row in rows:

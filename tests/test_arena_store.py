@@ -1,0 +1,440 @@
+"""Arena object-store tests: pre-faulted slabs, bulk extent leases, fused
+put/seal, extent-granular spill/evict/pin, coalesced releases, and the
+driver-side lease cache (reference: plasma's single pre-mapped arena,
+object_manager/plasma/plasma_allocator.cc, + NormalTaskSubmitter lease
+caching, transport/normal_task_submitter.h)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import rpc
+from ray_tpu._private.config import RayConfig
+from ray_tpu._private.ids import JobID, ObjectID, TaskID
+from ray_tpu._private.object_store import (
+    PlasmaClient,
+    PlasmaStore,
+    RemotePlasmaClient,
+    _align,
+    cleanup_client_connection,
+    register_store_handlers,
+)
+from ray_tpu._private.serialization import (
+    SerializedObject,
+    get_serialization_context,
+)
+from ray_tpu.exceptions import ObjectStoreFullError
+
+
+_TASK = TaskID.for_task(JobID.from_int(7))
+
+
+def oid(i=0):
+    return ObjectID.from_task(_TASK, i)
+
+
+MB = 1024 * 1024
+
+
+@pytest.fixture
+def small_slabs():
+    """Shrink slabs so arena paths exercise growth/eviction at test scale."""
+    old = RayConfig.arena_slab_bytes
+    RayConfig.set("arena_slab_bytes", 1 * MB)
+    yield
+    RayConfig.set("arena_slab_bytes", old)
+
+
+# ---------------------------------------------------------------- store unit
+class TestArenaStore:
+    def test_lease_seal_get_roundtrip(self, small_slabs):
+        store = PlasmaStore(capacity_bytes=8 * MB)
+        exts = store.lease_extents(256 * 1024, 256 * 1024)
+        slab, off, ln = exts[0]
+        assert ln >= _align(256 * 1024)
+        payload = b"q" * 1000
+        store.slabs[slab].shm.buf[off:off + len(payload)] = payload
+        assert store.seal_extent(oid(1), slab, off, len(payload),
+                                 _align(len(payload)))
+        got = store.get_local(oid(1))
+        assert got == (slab, len(payload), off)
+        mv = store.read_bytes(oid(1))
+        assert bytes(mv[:4]) == b"qqqq"
+        del mv
+        store.shutdown()
+
+    def test_store_full_during_extent_lease(self, small_slabs):
+        """An extent lease larger than what eviction can free must raise
+        ObjectStoreFullError instead of hanging or corrupting accounting."""
+        store = PlasmaStore(capacity_bytes=2 * MB)
+        exts = store.lease_extents(1 * MB, 1 * MB)
+        slab, off, _ln = exts[0]
+        store.seal_extent(oid(1), slab, off, 1 * MB, _align(1 * MB))
+        store.get_local(oid(1))  # pin: not evictable
+        with pytest.raises(ObjectStoreFullError):
+            # capacity 2 MiB: 1 MiB pinned + this 2 MiB request can't fit
+            store.lease_extents(2 * MB, 2 * MB)
+        # an unpinned object IS evictable: a fitting request succeeds
+        store.release(oid(1))
+        got = store.lease_extents(1 * MB, 1 * MB)
+        assert got
+        store.shutdown()
+
+    def test_arena_grows_before_evicting(self, small_slabs):
+        """With free capacity, a new slab is preferred over spilling the
+        LRU object (eviction is strictly worse than committing capacity)."""
+        store = PlasmaStore(capacity_bytes=8 * MB, spill_dir=None)
+        for i in range(4):
+            exts = store.lease_extents(1 * MB, 1 * MB)
+            slab, off, _ln = exts[0]
+            store.seal_extent(oid(i), slab, off, 1 * MB, _align(1 * MB))
+        assert store.num_spilled == 0
+        assert all(store.contains(oid(i)) for i in range(4))
+        assert len(store.slabs) >= 4
+        store.shutdown()
+
+    def test_spill_and_restore_extent(self, small_slabs, tmp_path):
+        """Sealed arena extents spill at extent granularity and restore
+        transparently on the next get."""
+        store = PlasmaStore(capacity_bytes=2 * MB, spill_dir=str(tmp_path))
+        a, b = oid(0), oid(1)
+        for o, fill in ((a, b"x"), (b, b"y")):
+            exts = store.lease_extents(1 * MB, 1 * MB)
+            slab, off, _ln = exts[0]
+            store.slabs[slab].shm.buf[off:off + MB] = fill * MB
+            store.seal_extent(o, slab, off, MB, _align(MB))
+        # force a: LRU spill to make room for a new lease
+        store.lease_extents(1 * MB, 1 * MB)
+        assert store.num_spilled >= 1
+        mv = store.read_bytes(a)  # restores from spill
+        assert bytes(mv[:2]) == b"xx"
+        del mv
+        store.shutdown()
+
+    def test_evict_while_reader_holds_mapping(self, small_slabs):
+        """A pinned extent never evicts; a DELETED extent with a live pin
+        parks as a zombie and is only reused after the last release — a
+        reader's zero-copy view must keep seeing its bytes."""
+        store = PlasmaStore(capacity_bytes=2 * MB)
+        exts = store.lease_extents(1 * MB, 1 * MB)
+        slab, off, _ln = exts[0]
+        store.slabs[slab].shm.buf[off:off + 4] = b"deed"
+        store.seal_extent(oid(1), slab, off, MB, _align(MB))
+        got = store.get_local(oid(1))  # reader pins + maps
+        assert got[0] == slab
+        store.delete(oid(1))
+        assert not store.contains(oid(1))
+        assert store.stats()["zombie_extents"] == 1
+        # the extent must NOT be reusable while the pin is live
+        assert store.slabs[slab].free_bytes() < _align(MB)
+        assert bytes(store.slabs[slab].shm.buf[off:off + 4]) == b"deed"
+        store.release(oid(1))  # last reader done
+        assert store.stats()["zombie_extents"] == 0
+        assert store.slabs[slab].free_bytes() >= _align(MB)
+        store.shutdown()
+
+    def test_fully_free_slab_reclaimed_for_legacy_create(self, small_slabs):
+        store = PlasmaStore(capacity_bytes=2 * MB)
+        exts = store.lease_extents(1 * MB, 1 * MB)
+        slab, off, ln = exts[0]
+        store.free_extent(slab, off, ln)
+        # a legacy create needing the full capacity reclaims the free slab
+        name = store.create(oid(9), 2 * MB - 8192)
+        assert name
+        assert not store.slabs  # slab unlinked to make room
+        store.shutdown()
+
+    def test_duplicate_seal_frees_extent(self, small_slabs):
+        store = PlasmaStore(capacity_bytes=4 * MB)
+        exts = store.lease_extents(1 * MB, 1 * MB)
+        slab, off, _ln = exts[0]
+        assert store.seal_extent(oid(1), slab, off, MB, _align(MB))
+        before = store.slabs[slab].free_bytes()
+        exts2 = store.lease_extents(1 * MB, 1 * MB)
+        s2, o2, _l2 = exts2[0]
+        assert not store.seal_extent(oid(1), s2, o2, MB, _align(MB))
+        # duplicate's extent went back to the free list
+        assert store.arena_free_bytes() >= before
+        store.shutdown()
+
+
+# ------------------------------------------------------------ client/server
+class TestArenaClientServer:
+    @pytest.fixture
+    def env(self, small_slabs):
+        io = rpc.EventLoopThread()
+        store = PlasmaStore(capacity_bytes=32 * MB)
+        handlers = {}
+        waiters = {}
+        register_store_handlers(handlers, store, waiters)
+        server = rpc.Server(handlers, name="store")
+        host, port = io.run(server.start())
+        conn = io.run(rpc.connect(host, port))
+        client = PlasmaClient(io, conn)
+        yield io, store, client, server, conn
+        client.close()
+        io.run(conn.close())
+        io.run(server.stop())
+        store.shutdown()
+        io.stop()
+
+    def _server_conn(self, server):
+        assert len(server.connections) == 1
+        return next(iter(server.connections))
+
+    def test_put_get_roundtrip_zero_rpc_seal(self, env):
+        io, store, client, server, conn = env
+        ctx = get_serialization_context()
+        arr = np.arange(64 * 1024, dtype=np.int64)
+        o = oid(1)
+        client.put_serialized(o, ctx.serialize(arr))
+        # the fused seal is fire-and-forget; the get's waiter absorbs it
+        mv = client.get_mapped(o, timeout=5)
+        assert mv is not None
+        ser = SerializedObject.from_buffer(mv)
+        ser.buffers = client.wrap_views(o, ser.buffers)
+        out = ctx.deserialize(ser)
+        np.testing.assert_array_equal(out, arr)
+        del out, ser, mv
+        client.release(o)
+
+    def test_release_deferred_until_views_die(self, env):
+        io, store, client, server, conn = env
+        ctx = get_serialization_context()
+        arr = np.arange(32 * 1024, dtype=np.int64)
+        o = oid(2)
+        client.put_serialized(o, ctx.serialize(arr))
+        mv = client.get_mapped(o, timeout=5)
+        ser = SerializedObject.from_buffer(mv)
+        ser.buffers = client.wrap_views(o, ser.buffers)
+        out = ctx.deserialize(ser)  # numpy view aliases the slab
+        del ser, mv
+        client.release(o)
+        time.sleep(0.3)
+
+        def entry_pins():
+            e = store.objects.get(o)
+            return e.pins if e is not None else 0
+
+        # view alive: the server-side pin must survive the release attempt
+        assert entry_pins() == 1
+        assert out.sum() == np.arange(32 * 1024, dtype=np.int64).sum()
+        del out  # view dies -> the flush loop's re-probe drops the pin
+        deadline = time.monotonic() + 10
+        while entry_pins() > 0 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert entry_pins() == 0
+
+    def test_coalesced_release_flush_on_teardown(self, env):
+        """close() must flush buffered releases so the store's pin table is
+        exact even before conn-loss cleanup would sweep it."""
+        io, store, client, server, conn = env
+        ctx = get_serialization_context()
+        o = oid(3)
+        client.put_serialized(o, ctx.serialize(b"z" * 200_000))
+        mv = client.get_mapped(o, timeout=5)
+        del mv
+        assert store.objects[o].pins == 1
+        client.release(o)
+        client.close()  # flush, no sleep: the release must not be lost
+        deadline = time.monotonic() + 5
+        while store.objects[o].pins > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert store.objects[o].pins == 0
+
+    def test_store_full_retry_returns_idle_extents(self, env):
+        """A client retrying store-full hands back its unused lease — its
+        own idle extents must never deadlock its next put."""
+        io, store, client, server, conn = env
+        ctx = get_serialization_context()
+        # lease most of the store to this client, use none of it
+        resp = conn.call_sync("plasma_lease_extents",
+                              {"bytes": 20 * MB, "contig": 20 * MB,
+                               "returns": []})
+        with client._extent_lock:
+            client._extents.extend([list(e) for e in resp["extents"]])
+        # a put bigger than the remaining free capacity still succeeds:
+        # the retry path returns the idle extents first
+        big = np.zeros(24 * MB, dtype=np.uint8)
+        o = oid(4)
+        client.put_serialized(o, ctx.serialize(big))
+        assert client.get_mapped(o, timeout=10) is not None
+        client.release(o)
+
+    def test_conn_cleanup_reclaims_leases(self, env):
+        io, store, client, server, conn = env
+        client._alloc_extent(2 * MB)
+        sconn = self._server_conn(server)
+        assert sconn.context.get("plasma_extents")
+        leased_before = store.arena_free_bytes()
+        cleanup_client_connection(store, sconn)
+        assert store.arena_free_bytes() > leased_before
+
+
+# -------------------------------------------------------- remote (ray://)
+class TestRemoteStreamingPut:
+    def test_iter_frame_matches_to_bytes(self):
+        ctx = get_serialization_context()
+        ser = ctx.serialize({"a": np.arange(100_000), "b": "x" * 50_000})
+        chunk = 64 * 1024
+        streamed = b"".join(bytes(p) for p in ser.iter_frame(chunk))
+        assert streamed == ser.to_bytes()
+        assert all(p.nbytes <= chunk for p in ser.iter_frame(chunk))
+
+    def test_remote_put_streams_chunks(self):
+        io = rpc.EventLoopThread()
+        store = PlasmaStore(capacity_bytes=64 * MB)
+        handlers, waiters = {}, {}
+        register_store_handlers(handlers, store, waiters)
+        server = rpc.Server(handlers, name="store")
+        host, port = io.run(server.start())
+        conn = io.run(rpc.connect(host, port))
+        client = RemotePlasmaClient(io, conn)
+        old_chunk = RayConfig.fetch_chunk_bytes
+        RayConfig.set("fetch_chunk_bytes", 256 * 1024)
+        try:
+            ctx = get_serialization_context()
+            arr = np.random.default_rng(0).integers(
+                0, 255, 4 * MB, dtype=np.uint8)
+            o = oid(5)
+            client.put_serialized(o, ctx.serialize(arr))
+            assert store.contains(o)
+            out = ctx.deserialize(
+                SerializedObject.from_buffer(store.read_bytes(o)))
+            np.testing.assert_array_equal(out, arr)
+            del out
+        finally:
+            RayConfig.set("fetch_chunk_bytes", old_chunk)
+            io.run(conn.close())
+            io.run(server.stop())
+            store.shutdown()
+            io.stop()
+
+
+# ---------------------------------------------------- lease cache (driver)
+class TestLeaseCache:
+    def test_reuse_then_return_on_idle_expiry(self):
+        """Back-to-back sync tasks reuse the cached lease (same worker, no
+        per-task lease round trip); once idle past lease_cache_idle_s the
+        leases go back to the nodelet."""
+        import ray_tpu
+        from ray_tpu._private import worker as worker_mod
+
+        old = RayConfig.lease_cache_idle_s
+        RayConfig.set("lease_cache_idle_s", 0.5)
+        ray_tpu.shutdown()
+        try:
+            ray_tpu.init(num_cpus=1)
+
+            @ray_tpu.remote
+            def worker_pid():
+                import os
+                return os.getpid()
+
+            p1 = ray_tpu.get(worker_pid.remote())
+            cw = worker_mod.global_worker_core()
+            requests_after_first = sum(
+                st.get("inflight", 0) for st in cw.submitter.classes.values())
+            p2 = ray_tpu.get(worker_pid.remote())
+            assert p1 == p2  # warm lease: same worker process
+            # cache hit: at least one class holds an idle (cached) lease
+            assert any(st["idle"] for st in cw.submitter.classes.values())
+            del requests_after_first
+            # expiry: leases return once idle past the knob
+            deadline = time.monotonic() + 10
+            while any(st["idle"] for st in cw.submitter.classes.values()) \
+                    and time.monotonic() < deadline:
+                time.sleep(0.1)
+            assert not any(
+                st["idle"] for st in cw.submitter.classes.values())
+            # and the class still schedules fine afterwards
+            assert ray_tpu.get(worker_pid.remote()) > 0
+        finally:
+            RayConfig.set("lease_cache_idle_s", old)
+            ray_tpu.shutdown()
+
+    def test_reclaim_hint_frees_cached_lease_for_actor(self):
+        """An actor needing the CPU a cached idle lease holds must not wait
+        out the idle timer: the nodelet's reclaim hint frees it."""
+        import ray_tpu
+
+        old = RayConfig.lease_cache_idle_s
+        RayConfig.set("lease_cache_idle_s", 60.0)  # only the hint can save us
+        ray_tpu.shutdown()
+        try:
+            ray_tpu.init(num_cpus=1)
+
+            @ray_tpu.remote
+            def noop():
+                return 1
+
+            assert ray_tpu.get(noop.remote()) == 1  # leaves a cached lease
+
+            @ray_tpu.remote(num_cpus=1)
+            class Pinger:
+                def ping(self):
+                    return "pong"
+
+            t0 = time.monotonic()
+            a = Pinger.remote()
+            assert ray_tpu.get(a.ping.remote(), timeout=45) == "pong"
+            # far faster than the 60s idle expiry: the hint did its job
+            assert time.monotonic() - t0 < 40
+        finally:
+            RayConfig.set("lease_cache_idle_s", old)
+            ray_tpu.shutdown()
+
+
+# --------------------------------------------------------- write-cache LRU
+class TestWriteCacheLRU:
+    def _client(self):
+        class _Conn:
+            closed = True
+        c = PlasmaClient.__new__(PlasmaClient)
+        import collections as _c
+        import threading as _t
+        c._write_cache = _c.OrderedDict()
+        c._write_cache_bytes = 0
+        c._write_lock = _t.Lock()
+        return c
+
+    def _fake_shm(self, size):
+        class _Shm:
+            def __init__(self, n):
+                self.size = n
+                self.closed = False
+
+            def close(self):
+                self.closed = True
+        return _Shm(size)
+
+    def test_eviction_is_lru_and_skips_busy(self):
+        c = self._client()
+        c._WRITE_CACHE_BYTES = 300
+        a, b, d = self._fake_shm(100), self._fake_shm(100), self._fake_shm(100)
+        now = time.monotonic()
+        c._write_cache["a"] = [a, 0, now]
+        c._write_cache["b"] = [b, 1, now]  # busy: a put is mid-write
+        c._write_cache["d"] = [d, 0, now]
+        c._write_cache_bytes = 300
+        with c._write_lock:
+            c._evict_write_cache_locked(100)
+        # a (LRU idle) evicted; busy b skipped; d retained
+        assert "a" not in c._write_cache and a.closed
+        assert "b" in c._write_cache and not b.closed
+        assert "d" in c._write_cache and not d.closed
+
+    def test_release_refreshes_recency(self):
+        c = self._client()
+        c._WRITE_CACHE_BYTES = 300
+        now = time.monotonic()
+        for k in ("a", "b", "d"):
+            c._write_cache[k] = [self._fake_shm(100), 0, now]
+        c._write_cache_bytes = 300
+        c._write_cache["a"][1] = 1
+        c._release_write("a")  # most-recently used now
+        with c._write_lock:
+            c._evict_write_cache_locked(100)
+        assert "a" in c._write_cache  # refreshed: b evicted instead
+        assert "b" not in c._write_cache

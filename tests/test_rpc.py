@@ -181,3 +181,110 @@ def test_protocol_version_negotiation():
         io.run(server.stop())
     finally:
         io.stop()
+
+
+# ------------------------------------------------- coalesced batch layer
+def test_notify_coalesced_batches_one_frame(io):
+    """Same-tick coalesced notifies arrive in order, dispatched from one
+    __batch__ frame."""
+    got = []
+    done = asyncio.Event()
+
+    async def sink(conn, obj):
+        got.append(obj)
+        if len(got) == 5:
+            done.set()
+
+    async def setup():
+        server = Server({"sink": sink}, name="s")
+        host, port = await server.start()
+        conn = await connect(host, port)
+        return server, conn
+
+    server, conn = io.run(setup())
+
+    async def send_all():
+        for i in range(5):
+            conn.notify_coalesced("sink", i)
+
+    io.run(send_all())
+    io.run(asyncio.wait_for(done.wait(), 5))
+    assert got == [0, 1, 2, 3, 4]
+    io.run(conn.close())
+    io.run(server.stop())
+
+
+def test_notify_coalesced_threadsafe_from_user_thread(io):
+    got = []
+    done = asyncio.Event()
+
+    async def sink(conn, obj):
+        got.append(obj)
+        done.set()
+
+    async def setup():
+        server = Server({"sink": sink}, name="s")
+        host, port = await server.start()
+        conn = await connect(host, port)
+        return server, conn
+
+    server, conn = io.run(setup())
+    conn.notify_coalesced_threadsafe("sink", {"k": 1})  # caller thread
+    io.run(asyncio.wait_for(done.wait(), 5))
+    assert got == [{"k": 1}]
+    io.run(conn.close())
+    io.run(server.stop())
+
+
+def test_call_pipelined_roundtrip_and_errors(io):
+    async def double(conn, obj):
+        return obj * 2
+
+    async def boom(conn, obj):
+        raise ValueError("pipeboom")
+
+    async def setup():
+        server = Server({"double": double, "boom": boom}, name="s")
+        host, port = await server.start()
+        conn = await connect(host, port)
+        return server, conn
+
+    server, conn = io.run(setup())
+
+    async def burst():
+        return await asyncio.gather(
+            *[conn.call_pipelined("double", i) for i in range(8)])
+
+    assert io.run(burst()) == [i * 2 for i in range(8)]
+    with pytest.raises(ValueError, match="pipeboom"):
+        io.run(conn.call_pipelined("boom", None, timeout=5))
+    io.run(conn.close())
+    io.run(server.stop())
+
+
+def test_coalesced_large_payload_falls_back(io):
+    """A payload over the batch threshold still arrives (own frame)."""
+    got = []
+    done = asyncio.Event()
+
+    async def sink(conn, obj):
+        got.append(obj)
+        done.set()
+
+    async def setup():
+        server = Server({"sink": sink}, name="s")
+        host, port = await server.start()
+        conn = await connect(host, port)
+        return server, conn
+
+    server, conn = io.run(setup())
+    big = np.arange(500_000, dtype=np.float64)  # oob buffer -> direct frame
+
+    async def send():
+        conn.notify_coalesced("sink", big)
+
+    io.run(send())
+    io.run(asyncio.wait_for(done.wait(), 5))
+    np.testing.assert_array_equal(got[0], big)
+    io.run(conn.close())
+    io.run(server.stop())

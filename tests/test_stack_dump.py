@@ -86,11 +86,22 @@ def test_multithreaded_task_stack_has_all_threads_and_task_id(
         ray_start_regular):
     ref = _multi_thread_sleep.remote(12.0)
     tid = ref.task_id().hex()
-    w = _wait_for(lambda: _worker_running(state.get_stacks(task_id=tid), tid))
-    assert w is not None, "running task never appeared in a stack dump"
-    # the user-spawned thread is captured alongside the executor thread
+
+    def running_with_inner_thread():
+        # a dump can catch the task tracked-but-not-yet-in-its-body (the
+        # inner thread spawns on the first body line); poll until BOTH the
+        # running task and its spawned thread are visible together
+        w = _worker_running(state.get_stacks(task_id=tid), tid)
+        if w is None:
+            return None
+        if "stacktest-inner" not in [t["thread_name"] for t in w["threads"]]:
+            return None
+        return w
+
+    w = _wait_for(running_with_inner_thread)
+    assert w is not None, \
+        "running task with its inner thread never appeared in a stack dump"
     names = [t["thread_name"] for t in w["threads"]]
-    assert "stacktest-inner" in names
     owned = [t for t in w["threads"] if t["task_id"] == tid]
     assert owned, f"no thread attributed to task {tid}: {names}"
     assert owned[0]["task_name"] == "_multi_thread_sleep"
