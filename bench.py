@@ -402,6 +402,46 @@ def main() -> None:
         except Exception as e:
             result["llm_decode_throughput"] = {"error": repr(e)}
 
+    # Collective data-path A/B (ISSUE 8): allreduce sweep (64 KiB -> 64 MiB,
+    # worlds 2/4) with serial vs chunk-pipelined vs int8-quantized vs
+    # hierarchical variants interleaved on the same actor group.  Runs in a
+    # subprocess that owns its runtime, like the microbenchmarks.
+    if os.environ.get("RAY_TPU_BENCH_COLLECTIVE", "1") != "0":
+        import subprocess
+        import sys
+
+        code = ("import json, ray_tpu; from ray_tpu._private.ray_perf "
+                "import host_cpu_count; "
+                "from ray_tpu._private.collective_bench "
+                "import run_collective_bench; "
+                "ray_tpu.init(num_cpus=max(host_cpu_count(), 4), "
+                "object_store_memory=1024**3); "
+                "print('COLLECTIVE=' + json.dumps(run_collective_bench()))")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        try:
+            proc = subprocess.Popen([sys.executable, "-c", code],
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True,
+                                    env=env, start_new_session=True)
+            try:
+                stdout, stderr = proc.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                import signal
+
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait()
+                raise
+            for line in stdout.splitlines():
+                if line.startswith("COLLECTIVE="):
+                    result["collective"] = json.loads(
+                        line[len("COLLECTIVE="):])
+                    break
+            else:
+                result["collective_error"] = (stderr or "no output")[-500:]
+        except Exception as e:
+            result["collective_error"] = repr(e)
+
     # Lint gate wall-clock (ISSUE 5): `ray_tpu lint` runs as a tier-1 test
     # on every PR; record its full-tree cost so the gate visibly stays
     # inside its < 10 s CPU budget instead of quietly becoming the slow

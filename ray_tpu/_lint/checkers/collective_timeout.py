@@ -12,10 +12,15 @@ must either pass one or inherit that default.
 Two sub-rules:
 
 - ``collective-timeout.def`` — a def named like a collective op inside
-  ``ray_tpu/util/collective/`` that does not take ``timeout_s``.  (The XLA
-  backend's in-device collectives run inside jit where wall-clock timeouts
-  are not expressible — that file carries a documented
-  ``lint: disable-file`` and is covered by the hang watchdog instead.)
+  ``ray_tpu/util/collective/`` that does not take ``timeout_s``.  Covers
+  compound entry points too: any PUBLIC def whose snake_case parts include
+  an op token (``quorum_allreduce``, ``hier_broadcast``,
+  ``allreduce_int8``, ...) is a collective entry point and must be
+  bounded; private ``_``-prefixed helpers inherit their caller's deadline
+  and are exempt.  (The XLA backend's in-device collectives run inside jit
+  where wall-clock timeouts are not expressible — that file carries a
+  documented ``lint: disable-file`` and is covered by the hang watchdog
+  instead.)
 - ``collective-timeout.call`` — a call through the collective API (module
   alias or ``from ... import recv``) to an op we cannot see a
   timeout-defaulted def for, without an explicit ``timeout_s=``.
@@ -33,6 +38,24 @@ COLLECTIVE_OPS = {"allreduce", "allgather", "reducescatter", "broadcast",
 _COLLECTIVE_MODULE = "ray_tpu.util.collective"
 
 
+def _entry_point_op(name: str):
+    """The collective op a def/attribute name denotes, or None.
+
+    Exact op names always count (even private, inside the collective
+    package the bare name IS the API); otherwise a public compound name
+    counts when any snake_case part is an op token — that's how the
+    quantized/hierarchical/quorum variants are spelled
+    (``quorum_allreduce``, ``hier_broadcast``, ``allreduce_int8``)."""
+    if name in COLLECTIVE_OPS:
+        return name
+    if name.startswith("_"):
+        return None
+    for part in name.split("_"):
+        if part in COLLECTIVE_OPS:
+            return part
+    return None
+
+
 def _collective_aliases(tree: ast.AST) -> tuple:
     """(module aliases, function aliases) bound to the collective package
     in this file."""
@@ -47,7 +70,7 @@ def _collective_aliases(tree: ast.AST) -> tuple:
             mod = node.module or ""
             if mod.startswith(_COLLECTIVE_MODULE):
                 for a in node.names:
-                    if a.name in COLLECTIVE_OPS:
+                    if _entry_point_op(a.name) is not None:
                         fn_aliases[a.asname or a.name] = a.name
                     elif a.name in ("collective", "xla"):
                         mod_aliases.add(a.asname or a.name)
@@ -79,7 +102,7 @@ class CollectiveTimeoutChecker(Checker):
                 continue
             for node in ast.walk(ctx.tree):
                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                        and node.name in COLLECTIVE_OPS:
+                        and _entry_point_op(node.name) is not None:
                     if _has_timeout_param(node):
                         defaulted_defs.add(node.name)
                     else:
@@ -118,7 +141,8 @@ class CollectiveTimeoutChecker(Checker):
     def _resolve_op(func, mod_aliases: Set[str], fn_aliases: Dict[str, str]):
         if isinstance(func, ast.Name):
             return fn_aliases.get(func.id)
-        if isinstance(func, ast.Attribute) and func.attr in COLLECTIVE_OPS:
+        if isinstance(func, ast.Attribute) \
+                and _entry_point_op(func.attr) is not None:
             base = func.value
             if isinstance(base, ast.Name) and base.id in mod_aliases:
                 return func.attr

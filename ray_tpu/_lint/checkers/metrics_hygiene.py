@@ -58,8 +58,11 @@ def collect_metrics(files: List[FileCtx]) -> List[Tuple[FileCtx, int, str,
 def _architecture_md(files: List[FileCtx]) -> str:
     """The repo's ARCHITECTURE.md, resolved from this package's location
     (empty string when absent — fixture trees skip the docs rule)."""
-    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    path = os.path.join(os.path.dirname(here), "docs", "ARCHITECTURE.md")
+    # __file__ = <repo>/ray_tpu/_lint/checkers/metrics_hygiene.py; the doc
+    # lives at <repo>/docs/ARCHITECTURE.md, three levels up
+    pkg = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(os.path.dirname(pkg), "docs", "ARCHITECTURE.md")
     try:
         with open(path, encoding="utf-8") as fh:
             return fh.read()

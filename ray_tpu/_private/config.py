@@ -184,6 +184,32 @@ _d("collective_op_timeout_s", float, 300.0, "single collective op timeout")
 _d("collective_default_timeout_s", float, 300.0,
    "default timeout_s for recv/barrier (and the other collectives); on "
    "expiry CollectiveTimeout names the group, op, and lagging rank(s)")
+_d("collective_pipeline", bool, True,
+   "pipelined ring data path: fire-and-forget chunked sends overlapped "
+   "with recv+reduce; off = the legacy serial blocking-send ring "
+   "(kept for interleaved A/B benchmarking)")
+_d("collective_chunk_bytes", int, 2 * 1024 * 1024,
+   "wire chunk size for pipelined ring collectives; each ring step's "
+   "payload is split into chunks this size so send, recv, and reduce "
+   "overlap instead of alternating; 0 = one chunk per step.  Smaller "
+   "chunks overlap better on fast links; larger ones amortize per-message "
+   "wakeups on shared-core hosts")
+_d("collective_shm_min_bytes", int, 64 * 1024,
+   "pipelined chunks at/above this size ride the per-group shared-memory "
+   "arena when sender and receiver share a node (only a small descriptor "
+   "crosses the RPC; the receiver reduces zero-copy out of the mapped "
+   "segment); 0 disables the shm channel")
+_d("collective_quant_block", int, 256,
+   "elements per int8 quantization scale block for quant='int8' "
+   "collectives (block-scaled symmetric quantization)")
+_d("collective_hier_min_bytes", int, 64 * 1024,
+   "topology='auto' picks the hierarchical two-level path at/above this "
+   "payload size when ranks span multiple nodes; below it the flat ring's "
+   "fewer hops win")
+_d("collective_virtual_nodes", int, 0,
+   "test/bench knob: partition ranks into this many synthetic nodes for "
+   "hierarchical topology (>0 overrides real node placement, so a "
+   "single-host world can exercise the two-level path)")
 
 # --- Runtime environments ---
 _d("runtime_env_pip_no_index", bool, False,

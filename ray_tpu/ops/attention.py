@@ -325,7 +325,9 @@ def ring_attention(q, k, v, *, axis_name: str = "sp", causal: bool = True,
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
-    ring = jax.lax.axis_size(axis_name)
+    # psum of a literal constant-folds to the axis size as a static int
+    # (jax.lax.axis_size only exists on newer jax releases).
+    ring = jax.lax.psum(1, axis_name)
     me = jax.lax.axis_index(axis_name)
     chunk = q.shape[2]
     b, h, _, d = q.shape

@@ -26,10 +26,11 @@ from ray_tpu.util.collective.collective import (
     reducescatter,
     send,
 )
-from ray_tpu.util.collective import xla
+from ray_tpu.util.collective import quantization, topology, xla
 
 __all__ = [
     "init_collective_group", "destroy_collective_group", "allreduce",
     "allgather", "reducescatter", "broadcast", "send", "recv", "barrier",
-    "get_rank", "get_collective_group_size", "get_group_progress", "xla",
+    "get_rank", "get_collective_group_size", "get_group_progress",
+    "quantization", "topology", "xla",
 ]

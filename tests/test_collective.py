@@ -71,6 +71,68 @@ class Member:
         self._group_default = name
         return self.rank
 
+    # ---- fast-collectives additions (quant / topology / quorum / A-B) ----
+
+    def allreduce_kw(self, value, kw):
+        return self.col.allreduce(np.asarray(value), group_name=self._g(),
+                                  **kw)
+
+    def timed_allreduce(self, value, kw):
+        import time
+
+        t0 = time.perf_counter()
+        out = self.col.allreduce(np.asarray(value), group_name=self._g(),
+                                 **kw)
+        return time.perf_counter() - t0, out
+
+    def quorum_allreduce(self, value, quorum, delay=0.0, timeout_s=None):
+        import time
+
+        if delay:
+            time.sleep(delay)
+        return self.col.allreduce(np.asarray(value), group_name=self._g(),
+                                  quorum=quorum, timeout_s=timeout_s)
+
+    def broadcast_kw(self, value, src_rank, kw):
+        return self.col.broadcast(np.asarray(value), src_rank=src_rank,
+                                  group_name=self._g(), **kw)
+
+    def set_config(self, name, value):
+        from ray_tpu._private.config import RayConfig
+
+        RayConfig.set(name, value)
+        return True
+
+    def set_ack_delay(self, delay_s):
+        from ray_tpu.util.collective import collective as ccore
+
+        ccore._groups[self._g()]._ack_delay_s = delay_s
+        return True
+
+    def group_stats(self):
+        from ray_tpu.util.collective import collective as ccore
+
+        g = ccore._groups[self._g()]
+        return {"last_quant_error": g.last_quant_error,
+                "last_quorum_late": g.last_quorum_late}
+
+    def shm_stats(self):
+        from ray_tpu.util.collective import collective as ccore
+
+        g = ccore._groups[self._g()]
+        return {"tx_active": g._shm_tx is not None,
+                "rx_attached": len(g._shm_rx._att)}
+
+    def allgather_then_churn(self, value, churn_value, rounds):
+        """allgather, hold the results, run ``rounds`` more allreduces,
+        THEN return the gathered list — catches results that alias shm
+        arena memory the later ops reuse."""
+        got = self.col.allgather(np.asarray(value), group_name=self._g())
+        for _ in range(rounds):
+            self.col.allreduce(np.asarray(churn_value),
+                               group_name=self._g())
+        return got
+
 
 @pytest.fixture(scope="module")
 def members():
@@ -260,6 +322,362 @@ def test_recv_timeout_raises_instead_of_blocking(ray_start_regular):
     try:
         with pytest.raises(CollectiveTimeout, match="rank 1"):
             ray_tpu.get(actors[0].recv_timeout.remote(1, 2.0))
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+# ------------------------------------------- wire quantization (unit level)
+
+def test_quantization_roundtrip_error_bound():
+    """Measured round-trip error never exceeds the analytic max block
+    scale / 2 bound, for assorted shapes and block sizes."""
+    from ray_tpu.util.collective.quantization import (
+        dequantize_blockwise, max_error_bound, quantize_blockwise,
+        wire_bytes)
+
+    rng = np.random.default_rng(7)
+    for shape, block in [((1000,), 64), ((33, 7), 16), ((5,), 256),
+                         ((4096,), 256)]:
+        x = rng.uniform(-3.0, 3.0, size=shape).astype(np.float32)
+        rec, err = quantize_blockwise(x, block=block)
+        y = dequantize_blockwise(rec)
+        assert y.shape == x.shape and y.dtype == np.float32
+        measured = float(np.abs(y - x).max())
+        assert measured <= max_error_bound(rec) + 1e-6
+        assert abs(measured - err) <= 1e-6  # reported error IS the actual
+        # int8 payload + fp32 scales must beat fp32 wire bytes by ~4x
+        assert wire_bytes(rec) < x.nbytes / 2
+
+
+def test_quantization_zero_blocks_safe():
+    from ray_tpu.util.collective.quantization import (
+        dequantize_blockwise, quantize_blockwise)
+
+    rec, err = quantize_blockwise(np.zeros(100, np.float32), block=32)
+    assert err == 0.0
+    assert np.all(dequantize_blockwise(rec) == 0.0)
+
+
+def test_topology_selection():
+    from ray_tpu.util.collective import topology as topo
+
+    two_nodes = {0: "a", 1: "a", 2: "b", 3: "b"}
+    big, small = 1 << 20, 1024
+    assert topo.select(4, two_nodes, big) == "hier"
+    assert topo.select(4, two_nodes, small) == "ring"       # latency-bound
+    assert topo.select(4, {r: "a" for r in range(4)}, big) == "ring"
+    assert topo.select(4, {0: "a", 1: "b", 2: "c", 3: "d"}, big) == "ring"
+    assert topo.select(4, two_nodes, small, "hier") == "hier"  # explicit
+    p = topo.plan(2, 4, two_nodes, big)
+    assert p.kind == "hier" and p.leaders == [0, 2]
+    assert p.is_leader and p.members == [3]
+    p1 = topo.plan(1, 4, two_nodes, big)
+    assert not p1.is_leader and p1.leader == 0 and p1.members == []
+
+
+# ----------------------------------------- quant / topology / quorum (e2e)
+
+def test_allreduce_int8_error_bounded(members):
+    """int8 allreduce lands within the documented bound: one quant stage
+    per ring hop, each <= (partial-sum absmax)/254, summing to roughly
+    n(n+1)/(2*254) for inputs in [-1, 1]."""
+    rng = np.random.default_rng(11)
+    data = [rng.uniform(-1.0, 1.0, 1024).astype(np.float32)
+            for _ in range(WORLD)]
+    exact = np.sum(data, axis=0)
+    outs = ray_tpu.get([a.allreduce_kw.remote(data[i], {"quant": "int8"})
+                        for i, a in enumerate(members)])
+    bound = WORLD * (WORLD + 1) / (2 * 254) + 1e-3
+    for o in outs:
+        assert float(np.abs(o - exact).max()) <= bound
+    # every rank reported a measured (nonzero, bounded) quant error
+    stats = ray_tpu.get([a.group_stats.remote() for a in members])
+    for s in stats:
+        assert 0.0 < s["last_quant_error"] <= bound
+
+
+def test_broadcast_int8_single_stage(members):
+    """Broadcast quantizes once at the root and relays verbatim: error is
+    one stage, <= absmax/254."""
+    rng = np.random.default_rng(13)
+    val = rng.uniform(-1.0, 1.0, 512).astype(np.float32)
+    outs = ray_tpu.get([a.broadcast_kw.remote(val, 1, {"quant": "int8"})
+                        for a in members])
+    for o in outs:
+        assert float(np.abs(np.asarray(o, np.float32) - val).max()) \
+            <= 1.0 / 254 + 1e-6
+    # all receivers dequantize the SAME record -> identical results
+    # (the root returns its own exact array, so compare non-root ranks)
+    recv_outs = [o for i, o in enumerate(outs) if i != 1]
+    for o in recv_outs[1:]:
+        np.testing.assert_array_equal(np.asarray(o), np.asarray(recv_outs[0]))
+
+
+def test_allreduce_multichunk_exact(ray_start_regular):
+    """Payloads spanning many wire chunks reduce exactly (tag-per-chunk
+    stream reassembly)."""
+    actors = _fresh_group(2, "chunks")
+    try:
+        ray_tpu.get([a.set_config.remote("collective_chunk_bytes", 1024)
+                     for a in actors])
+        data = [np.arange(2000, dtype=np.float64) * (i + 1)
+                for i in range(2)]
+        outs = ray_tpu.get([a.allreduce_kw.remote(data[i], {})
+                            for i, a in enumerate(actors)])
+        expect = data[0] + data[1]
+        for o in outs:
+            np.testing.assert_array_equal(o, expect)
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def test_hierarchical_matches_ring_bitwise(ray_start_regular):
+    """Two-level (virtual 2-node) allreduce must produce bit-identical
+    fp32 output to the flat ring on integer-valued data."""
+    n = 4
+    actors = _fresh_group(n, "hier")
+    try:
+        ray_tpu.get([a.set_config.remote("collective_virtual_nodes", 2)
+                     for a in actors])
+        rng = np.random.default_rng(17)
+        data = [rng.integers(-8, 8, size=(64, 3)).astype(np.float32)
+                for _ in range(n)]
+        ring = ray_tpu.get([
+            a.allreduce_kw.remote(data[i], {"topology": "ring"})
+            for i, a in enumerate(actors)])
+        hier = ray_tpu.get([
+            a.allreduce_kw.remote(data[i], {"topology": "hier"})
+            for i, a in enumerate(actors)])
+        expect = np.sum(data, axis=0)
+        for r, h in zip(ring, hier):
+            np.testing.assert_array_equal(r, expect)
+            np.testing.assert_array_equal(h, expect)  # bit-identical
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def test_quorum_returns_early_then_folds_in(ray_start_regular):
+    """allreduce(quorum=K) returns without the straggler; its late
+    contribution folds into the next quorum op so cumulative sums match
+    full participation (arXiv:2505.23523 shape)."""
+    import time
+
+    n = 3
+    actors = _fresh_group(n, "quorum")
+    v = [np.full(8, float(10 ** i)) for i in range(n)]  # 1, 10, 100
+    w = [np.full(8, 2.0 * (i + 1)) for i in range(n)]   # 2, 4, 6
+    try:
+        # round 1: ranks 0/1 contribute now, rank 2 is 2.5 s late
+        t0 = time.perf_counter()
+        fast = [actors[0].quorum_allreduce.remote(v[0], 2),
+                actors[1].quorum_allreduce.remote(v[1], 2)]
+        late = actors[2].quorum_allreduce.remote(v[2], 2, delay=2.5)
+        r0, r1 = ray_tpu.get(fast)
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 2.0, f"quorum waited for the straggler ({elapsed:.2f}s)"
+        np.testing.assert_allclose(r0, v[0] + v[1])  # 11, not 111
+        np.testing.assert_allclose(r1, v[0] + v[1])
+        # the straggler still gets round 1's (quorum-only) result
+        np.testing.assert_allclose(ray_tpu.get(late), v[0] + v[1])
+        assert ray_tpu.get(actors[0].group_stats.remote())[
+            "last_quorum_late"] == [2]
+        # round 2 (full quorum): rank 2's parked round-1 payload folds in
+        outs = ray_tpu.get([a.quorum_allreduce.remote(w[i], n)
+                            for i, a in enumerate(actors)])
+        round2 = w[0] + w[1] + w[2] + v[2]
+        for o in outs:
+            np.testing.assert_allclose(o, round2)
+        # cumulative across rounds == full participation
+        np.testing.assert_allclose(r0 + outs[0], np.sum(v + w, axis=0))
+        assert ray_tpu.get(actors[0].group_stats.remote())[
+            "last_quorum_late"] == []
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def test_pipelined_ring_overlaps_delayed_acks(ray_start_regular):
+    """Regression for the serial-send ring: with one rank's ACK path
+    delayed, the legacy blocking ring pays the delay on every hop while
+    the pipelined ring (fire-and-forget sends) does not."""
+    n = 3
+    actors = _fresh_group(n, "overlap")
+    try:
+        ray_tpu.get(actors[1].set_ack_delay.remote(0.25))
+        ray_tpu.get([a.set_config.remote("collective_pipeline", False)
+                     for a in actors])
+        serial = ray_tpu.get([
+            a.timed_allreduce.remote(np.full(8, float(i)), {})
+            for i, a in enumerate(actors)])
+        t_serial = max(t for t, _ in serial)
+        ray_tpu.get([a.set_config.remote("collective_pipeline", True)
+                     for a in actors])
+        piped = ray_tpu.get([
+            a.timed_allreduce.remote(np.full(8, float(i)), {})
+            for i, a in enumerate(actors)])
+        t_piped = max(t for t, _ in piped)
+        expect = np.full(8, float(sum(range(n))))
+        for _, o in serial + piped:
+            np.testing.assert_allclose(o, expect)
+        # serial pays >= 4 hops x 0.25 s of ACK waits; pipelined doesn't
+        assert t_serial > 0.7, f"serial ring unexpectedly fast: {t_serial:.2f}s"
+        assert t_piped < 0.4, f"pipelined ring stalled on ACKs: {t_piped:.2f}s"
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def test_timeout_names_rank_under_new_paths(ray_start_regular):
+    """CollectiveTimeout still names the lagging rank on the hierarchical
+    and quorum paths."""
+    from ray_tpu.exceptions import CollectiveTimeout
+
+    actors = _fresh_group(3, "tmo-hier")
+    try:
+        ray_tpu.get([a.set_config.remote("collective_virtual_nodes", 2)
+                     for a in actors[:2]])
+        # ranks 0 (leader) and 1 (member) enter; rank 2 (other node) never
+        refs = [actors[0].allreduce_kw.remote(
+                    np.ones(4), {"topology": "hier", "timeout_s": 3.0}),
+                actors[1].allreduce_kw.remote(
+                    np.ones(4), {"topology": "hier", "timeout_s": 3.0})]
+        for ref in refs:
+            with pytest.raises(CollectiveTimeout, match="rank 2"):
+                ray_tpu.get(ref)
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+
+    actors = _fresh_group(2, "tmo-quorum")
+    try:
+        with pytest.raises(CollectiveTimeout, match="rank 1"):
+            ray_tpu.get(actors[0].quorum_allreduce.remote(
+                np.ones(4), 2, timeout_s=2.0))
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+# ------------------------------------------ shared-memory chunk channel
+
+def test_shm_arena_place_resolve_unit():
+    """TxArena/RxCache round trip plus the reuse rules: fan-out descriptor
+    caching, parity-half alternation, growth keeping the old segment
+    attachable for two placing ops before unlinking."""
+    import os
+    import uuid
+
+    from ray_tpu.util.collective import shm_channel as shm_ch
+
+    tx = shm_ch.TxArena(f"shmt-{os.getpid()}-{uuid.uuid4().hex[:6]}")
+    rx = shm_ch.RxCache()
+    try:
+        a = np.arange(65536, dtype=np.float32)
+        d1 = tx.place(a, seq=1, tag=5, min_bytes=1024)
+        assert shm_ch.is_desc(d1) and shm_ch.desc_bytes(d1) == a.nbytes
+        np.testing.assert_array_equal(rx.resolve(d1), a)
+        # fan-out sends of the same payload within one op share the desc
+        assert tx.place(a, seq=1, tag=5, min_bytes=1024) is d1
+        # tiny payloads decline (caller sends them inline)
+        assert tx.place(np.ones(4, np.float32), seq=2, tag=5,
+                        min_bytes=1024) is None
+        # consecutive placing ops land in alternating halves...
+        b = a * 2.0
+        d2 = tx.place(b, seq=3, tag=5, min_bytes=1024)
+        assert d2["seg"] == d1["seg"]
+        assert d2["bufs"][0][0] != d1["bufs"][0][0]
+        # ...and the third reuses the first op's half
+        c = a * 3.0
+        d3 = tx.place(c, seq=4, tag=5, min_bytes=1024)
+        assert d3["bufs"][0][0] == d1["bufs"][0][0]
+        np.testing.assert_array_equal(rx.resolve(d3), c)
+        # growth: a payload over half the cap moves to a larger segment;
+        # the old one stays attachable for two more placing ops
+        big = np.ones(3 * 1024 * 1024, np.float32)  # 12 MiB > 8 MiB cap
+        d4 = tx.place(big, seq=5, tag=5, min_bytes=1024)
+        assert d4["seg"] != d1["seg"]
+        np.testing.assert_array_equal(rx.resolve(d4), big)
+        shm_ch._attach(d1["seg"]).close()  # still linked
+        tx.place(a, seq=6, tag=5, min_bytes=1024)
+        tx.place(a, seq=7, tag=5, min_bytes=1024)  # retire point passed
+        with pytest.raises(FileNotFoundError):
+            shm_ch._attach(d1["seg"])
+    finally:
+        rx.close()
+        tx.close()
+
+
+def test_allreduce_large_shm_engages_and_matches_tcp(ray_start_regular):
+    """Bulk same-node chunks ride the shm arena (descriptors on the wire)
+    and produce the identical result as the TCP inline path."""
+    actors = _fresh_group(2, "shm-ring")
+    try:
+        rng = np.random.default_rng(23)
+        data = [rng.standard_normal(256 * 1024).astype(np.float32)
+                for _ in range(2)]
+        with_shm = ray_tpu.get([a.allreduce_kw.remote(data[i], {})
+                                for i, a in enumerate(actors)])
+        stats = ray_tpu.get([a.shm_stats.remote() for a in actors])
+        assert all(s["tx_active"] for s in stats), stats
+        assert all(s["rx_attached"] >= 1 for s in stats), stats
+        # shm off -> same bytes through the TCP inline path
+        ray_tpu.get([a.set_config.remote("collective_shm_min_bytes", 0)
+                     for a in actors])
+        no_shm = ray_tpu.get([a.allreduce_kw.remote(data[i], {})
+                              for i, a in enumerate(actors)])
+        expect = data[0] + data[1]
+        for w, t in zip(with_shm, no_shm):
+            np.testing.assert_array_equal(w, expect)
+            np.testing.assert_array_equal(t, expect)
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def test_allgather_large_results_detached_from_arena(ray_start_regular):
+    """allgather results must be copies, not views of arena memory:
+    subsequent ops reuse the arena halves, so a rank that holds gathered
+    arrays across later collectives must still see the original bytes."""
+    n = 3
+    actors = _fresh_group(n, "shm-ag")
+    try:
+        data = [np.full(64 * 1024, float(i + 1), np.float32)
+                for i in range(n)]
+        churn = np.ones(128 * 1024, np.float32)  # cycles both parity halves
+        outs = ray_tpu.get([
+            a.allgather_then_churn.remote(data[i], churn, 3)
+            for i, a in enumerate(actors)])
+        for got in outs:
+            assert len(got) == n
+            for r in range(n):
+                np.testing.assert_array_equal(got[r], data[r])
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def test_hierarchical_large_shm_exact(ray_start_regular):
+    """The two-level path's gather + leader-broadcast legs ride the arena
+    for bulk payloads and still reduce exactly."""
+    n = 4
+    actors = _fresh_group(n, "shm-hier")
+    try:
+        ray_tpu.get([a.set_config.remote("collective_virtual_nodes", 2)
+                     for a in actors])
+        rng = np.random.default_rng(29)
+        data = [rng.integers(-8, 8, size=256 * 1024).astype(np.float32)
+                for _ in range(n)]
+        outs = ray_tpu.get([
+            a.allreduce_kw.remote(data[i], {"topology": "hier"})
+            for i, a in enumerate(actors)])
+        expect = np.sum(data, axis=0)
+        for o in outs:
+            np.testing.assert_array_equal(o, expect)
+        stats = ray_tpu.get([a.shm_stats.remote() for a in actors])
+        assert any(s["tx_active"] for s in stats), stats
     finally:
         for a in actors:
             ray_tpu.kill(a)

@@ -267,6 +267,40 @@ sock.recv(1024)                              # not a collective alias: fine
     assert "`barrier`" in result.findings[0].message
 
 
+def test_collective_timeout_def_compound_entry_points():
+    """Quantized/hierarchical/quorum entry points — public defs whose name
+    CONTAINS an op token — must be bounded too; private helpers inheriting
+    their caller's deadline are exempt."""
+    mixed = FileCtx("ray_tpu/util/collective/collective.py", '''
+def quorum_allreduce(value, quorum):          # BAD: unbounded entry point
+    pass
+def hier_broadcast(value, root=0):            # BAD: unbounded entry point
+    pass
+def allreduce_int8(value, timeout_s=None):    # bounded: fine
+    pass
+def _rs_flat(flats, op, seq, deadline):       # private helper: exempt
+    pass
+def quantize_blockwise(arr, block=0):         # no op token: fine
+    pass
+''')
+    result = run_lint(files=[mixed], checkers=["collective-timeout"],
+                      baseline=None)
+    assert rules_of(result.findings) == ["collective-timeout.def"] * 2
+    assert "`quorum_allreduce`" in result.findings[0].message
+    assert "`hier_broadcast`" in result.findings[1].message
+
+
+def test_collective_timeout_call_compound_alias():
+    caller = FileCtx("ray_tpu/train/_session.py", '''
+from ray_tpu.util.collective import quorum_allreduce
+quorum_allreduce(x, 2)                  # BAD: no bounded def seen
+quorum_allreduce(x, 2, timeout_s=5.0)   # explicit timeout: fine
+''')
+    result = run_lint(files=[caller], checkers=["collective-timeout"],
+                      baseline=None)
+    assert rules_of(result.findings) == ["collective-timeout.call"]
+
+
 def test_collective_timeout_call_inherits_module_default():
     colmod = FileCtx("ray_tpu/util/collective/collective.py", '''
 def barrier(group_name="default", timeout_s=None):
