@@ -226,10 +226,33 @@ class Group:
     def _send_payload(self, rank: int, payload, seq: int, tag: int,
                       deadline: Optional[float], pipelined: bool,
                       shm_ok: bool = True):
+        detached = False
+        if shm_ch.is_desc(payload) and self._member_nodes.get(rank) != \
+                self._member_nodes.get(self.rank):
+            # Cross-node relay: the descriptor names a POSIX segment that
+            # only exists on the origin node — a remote receiver would
+            # FileNotFoundError on attach (or map a stale same-name
+            # segment).  Materialize an inline copy before it leaves the
+            # node; same-node relays still forward the descriptor verbatim.
+            payload = self._shm_resolve(payload, copy=True)
+            detached = True
         self._op_bytes += _payload_bytes(payload)
         if pipelined:
-            self._post_send(rank, self._shm_wire(rank, payload, seq, tag,
-                                                 shm_ok), seq, tag)
+            wire = self._shm_wire(rank, payload, seq, tag, shm_ok)
+            if wire is payload and not detached \
+                    and isinstance(wire, np.ndarray) \
+                    and wire.nbytes >= rpc._OOB_THRESHOLD:
+                # Inline arrays at/above the RPC out-of-band threshold are
+                # held as zero-copy views until the IO loop writes the
+                # frame; the allgather phase overwrites exactly the slices
+                # reduce-scatter sent, and callers may mutate their tensor
+                # the moment the op returns — either corrupts a frame
+                # still queued behind transport backpressure.  Detach a
+                # copy.  (Smaller payloads were fully pickled inband at
+                # post time; quant records and descriptors are already
+                # frame-stable.)
+                wire = np.array(wire)
+            self._post_send(rank, wire, seq, tag)
         else:
             self._send_to(rank, payload, seq, tag, deadline=deadline)
 
@@ -239,8 +262,10 @@ class Group:
         destination shares our node.  ``shm_ok=False`` marks sends whose
         consumption is not completion-synchronized (plain broadcast
         fan-out, quorum traffic) — those stay inline; see shm_channel.py.
-        Descriptors being relayed pass through verbatim (the receiver
-        attaches the ORIGIN arena by name)."""
+        Descriptors being relayed to a SAME-node destination pass through
+        verbatim (the receiver attaches the ORIGIN arena by name);
+        cross-node relays were already resolved to inline copies in
+        :meth:`_send_payload`."""
         min_bytes = RayConfig.collective_shm_min_bytes
         if not shm_ok or min_bytes <= 0 or shm_ch.is_desc(payload) \
                 or self._member_nodes.get(rank) != \
@@ -260,8 +285,12 @@ class Group:
         if not shm_ch.is_desc(payload):
             return payload
         out = self._shm_rx.resolve(payload)
-        if copy and isinstance(out, np.ndarray):
-            out = out.copy()
+        if copy:
+            if isinstance(out, np.ndarray):
+                out = out.copy()
+            elif is_quantized(out):
+                # record arrays are zero-copy views over the arena too
+                out = dict(out, d=np.array(out["d"]), s=np.array(out["s"]))
         return out
 
     def _recv_from(self, rank: int, seq: int, tag: int = 0,
@@ -401,6 +430,18 @@ class Group:
         if is_quantized(payload):
             return dequantize_blockwise(payload)
         return np.asarray(payload)
+
+    @staticmethod
+    def _dequant_to_input(rec) -> np.ndarray:
+        """Dequantize a wire record back to the SENDER's dtype (gather
+        results hand back what each rank contributed, not a float32
+        reduce accumulator; integer inputs round-to-nearest instead of
+        truncating)."""
+        out = dequantize_blockwise(rec)
+        dt = np.dtype(rec["dtype"])
+        if not np.issubdtype(dt, np.floating):
+            np.rint(out, out=out)
+        return out.astype(dt)
 
     # ------------------------------------------------------------ primitives
     # Ring topology (bandwidth-optimal, like NCCL's host rings): allreduce =
@@ -688,6 +729,11 @@ class Group:
 
     def allgather(self, array, timeout_s: Optional[float] = None,
                   quant: Optional[str] = None) -> List[np.ndarray]:
+        """Gather every rank's array.  With ``quant="int8"`` each entry —
+        this rank's own included — is the owner's single
+        quantize→dequantize round trip cast back to the owner's dtype, so
+        every rank sees the identical list (the own entry is NOT kept
+        exact: that would make results asymmetric across ranks)."""
         _check_quant(quant)
         seq = self._begin_op("allgather")
         deadline = self._deadline(timeout_s)
@@ -695,7 +741,9 @@ class Group:
         n = self.world_size
         try:
             if n == 1:
-                return [arr.copy()]
+                return [self._dequant_to_input(self._maybe_quant(
+                    np.ascontiguousarray(arr), quant))
+                    if quant is not None else arr.copy()]
             # per-rank payloads may differ in shape: rotate whole payloads
             # (quantized once at the owner, relayed verbatim — one quant
             # stage of error total)
@@ -703,8 +751,9 @@ class Group:
             right = (self.rank + 1) % n
             left = (self.rank - 1) % n
             items: List[Any] = [None] * n
-            items[self.rank] = arr
             pay = self._maybe_quant(np.ascontiguousarray(arr), quant)
+            items[self.rank] = self._dequant_to_input(pay) \
+                if quant is not None else arr
             self._send_payload(right, pay, seq, _TAG_AG, deadline, pipelined)
             for step in range(n - 1):
                 recv_i = (self.rank - step - 1) % n
@@ -718,8 +767,9 @@ class Group:
                         deadline, pipelined)
                 # copy=True: the result leaves the op, so it must not
                 # alias arena memory the sender will reuse
-                items[recv_i] = self._maybe_dequant(
-                    self._shm_resolve(incoming, copy=True))
+                data = self._shm_resolve(incoming, copy=True)
+                items[recv_i] = self._dequant_to_input(data) \
+                    if is_quantized(data) else np.asarray(data)
             return [np.asarray(c) for c in items]
         finally:
             self._finish_op("allgather", quant)
@@ -931,6 +981,12 @@ def allreduce(tensor, group_name: str = "default", op: str = "sum",
 def allgather(tensor, group_name: str = "default",
               timeout_s: Optional[float] = None,
               quant: Optional[str] = None):
+    """Gather every rank's tensor into a list indexed by rank.
+
+    With ``quant="int8"`` every entry (including this rank's own) is the
+    owner's quantize→dequantize round trip cast back to the owner's
+    dtype — all ranks observe the identical list, at one quant stage of
+    error per entry."""
     return _group(group_name).allgather(tensor, timeout_s=timeout_s,
                                         quant=quant)
 

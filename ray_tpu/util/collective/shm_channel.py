@@ -19,7 +19,10 @@ from each of them), and a rank only starts op k+1 after finishing op k —
 so by the time the sender begins its (k+2)-nd placing op and reuses the
 half of op k, every peer has finished op k and consumed its chunks.
 Relayed descriptors inherit the guarantee: relays are consumed within the
-same op they were placed in.  Ops WITHOUT that completion dependency —
+same op they were placed in, and a descriptor never leaves its node — the
+collective layer resolves it to an inline copy before any cross-node send
+(a remote host could not attach the segment by name).  Ops WITHOUT that
+completion dependency —
 plain broadcast fan-out (the root completes without any peer
 participation) and quorum contributions / results (the root completes
 without the stragglers; contributions may park across rounds) — must not

@@ -66,10 +66,9 @@ def select(world_size: int, nodes: Optional[Dict[int, str]],
     nm = node_map(world_size, nodes)
     distinct = set(nm.values())
     if topo == "hier":
-        if len(distinct) < 2:
-            # every rank on one node: two-level degenerates to gather+ring —
-            # honor the explicit request anyway (bench/tests rely on it)
-            return "hier"
+        # honored even when every rank shares one node and the two levels
+        # degenerate to gather+ring (bench/tests rely on the explicit
+        # request)
         return "hier"
     if topo == "ring":
         return "ring"

@@ -123,6 +123,69 @@ class Member:
         return {"tx_active": g._shm_tx is not None,
                 "rx_attached": len(g._shm_rx._att)}
 
+    def allgather_kw(self, value, kw):
+        return self.col.allgather(np.asarray(value), group_name=self._g(),
+                                  **kw)
+
+    def patch_nodes(self, node_of_rank):
+        """Simulate a multi-node world on one host: override the
+        rendezvous node map and count shm descriptors arriving from
+        cross-node senders — a real remote host could never attach those
+        segments by name, so receiving one IS the relay bug."""
+        from ray_tpu.util.collective import collective as ccore
+        from ray_tpu.util.collective import shm_channel as shm_ch
+
+        g = ccore._groups[self._g()]
+        g._member_nodes = {int(r): n for r, n in node_of_rank.items()}
+        g._test_cross_descs = 0
+        orig = g._on_message
+
+        async def counting(conn, msg):
+            if shm_ch.is_desc(msg.get("data")) and \
+                    g._member_nodes.get(msg["src"]) != \
+                    g._member_nodes.get(g.rank):
+                g._test_cross_descs += 1
+            return await orig(conn, msg)
+
+        g.core.server.handlers[g._handler_name] = counting
+        return True
+
+    def cross_desc_count(self):
+        from ray_tpu.util.collective import collective as ccore
+
+        return ccore._groups[self._g()]._test_cross_descs
+
+    def op_capture_posted(self, op, value, kw):
+        """Run one op with a spy on _post_send: snapshot every inline
+        ndarray at post time, mutate the input right after the op
+        returns, and report whether any posted buffer changed afterward
+        (a queued fire-and-forget frame must own stable bytes)."""
+        import types
+
+        from ray_tpu.util.collective import collective as ccore
+
+        g = ccore._groups[self._g()]
+        posted = []
+        orig = ccore.Group._post_send
+
+        def spy(gself, rank, data, seq, tag=0):
+            if isinstance(data, np.ndarray):
+                posted.append((data, data.copy()))
+            return orig(gself, rank, data, seq, tag)
+
+        g._post_send = types.MethodType(spy, g)
+        try:
+            arr = np.asarray(value).copy()
+            out = np.array(getattr(self.col, op)(
+                arr, group_name=self._g(), **kw))
+            arr.fill(-1e9)  # caller reuses its buffer right after return
+            corrupted = sum(1 for obj, snap in posted
+                            if not np.array_equal(obj, snap))
+            return {"posted": len(posted), "corrupted": corrupted,
+                    "out": out}
+        finally:
+            del g._post_send  # instance attr shadowing the class method
+
     def allgather_then_churn(self, value, churn_value, rounds):
         """allgather, hold the results, run ``rounds`` more allreduces,
         THEN return the gathered list — catches results that alias shm
@@ -681,3 +744,98 @@ def test_hierarchical_large_shm_exact(ray_start_regular):
     finally:
         for a in actors:
             ray_tpu.kill(a)
+
+
+# --------------------------------------------- PR 7 review regressions
+
+def test_ring_relay_never_ships_desc_cross_node(ray_start_regular):
+    """A shm descriptor names a POSIX segment that exists only on its
+    origin node: relays whose next hop lives on another node must resolve
+    it to an inline copy (on a real two-node world the raw relay is a
+    FileNotFoundError on attach, or worse, a stale same-name segment).
+    Single-host runs can attach cross-'node', so assert the invariant
+    directly: no rank ever RECEIVES a descriptor from a cross-node
+    sender, on both relay paths (ring allgather phase, whole-payload
+    allgather rotation), while same-node hops still ride the arena."""
+    n = 4
+    actors = _fresh_group(n, "xnode")
+    try:
+        nodes = {0: "nodeA", 1: "nodeA", 2: "nodeB", 3: "nodeB"}
+        ray_tpu.get([a.patch_nodes.remote(nodes) for a in actors])
+        rng = np.random.default_rng(31)
+        data = [rng.integers(-8, 8, size=256 * 1024).astype(np.float32)
+                for _ in range(n)]
+        outs = ray_tpu.get([
+            a.allreduce_kw.remote(data[i], {"topology": "ring"})
+            for i, a in enumerate(actors)])
+        expect = np.sum(data, axis=0)
+        for o in outs:
+            np.testing.assert_array_equal(o, expect)
+        ag = ray_tpu.get([a.allgather_kw.remote(data[i], {})
+                          for i, a in enumerate(actors)])
+        for got in ag:
+            for r in range(n):
+                np.testing.assert_array_equal(got[r], data[r])
+        counts = ray_tpu.get([a.cross_desc_count.remote() for a in actors])
+        assert all(c == 0 for c in counts), \
+            f"descriptors crossed 'nodes': {counts}"
+        stats = ray_tpu.get([a.shm_stats.remote() for a in actors])
+        assert any(s["tx_active"] for s in stats), stats
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def test_pipelined_inflight_frames_own_their_bytes(ray_start_regular):
+    """Inline pipelined sends above the RPC out-of-band threshold must be
+    detached copies: the allgather phase overwrites exactly the slices
+    reduce-scatter posted, and a caller may mutate its tensor the moment
+    an op returns, while the frames can still be queued behind a slow
+    peer.  Snapshot every posted array at post time and verify none
+    changed afterward."""
+    n = 2
+    actors = _fresh_group(n, "detach")
+    try:
+        # force the TCP inline path so the posted payloads are ndarrays
+        ray_tpu.get([a.set_config.remote("collective_shm_min_bytes", 0)
+                     for a in actors])
+        data = [np.full(64 * 1024, float(i + 1), np.float32)
+                for i in range(n)]
+        r0, _ = ray_tpu.get([
+            actors[0].op_capture_posted.remote("allreduce", data[0], {}),
+            actors[1].allreduce_kw.remote(data[1], {})])
+        np.testing.assert_array_equal(r0["out"], data[0] + data[1])
+        assert r0["posted"] > 0
+        assert r0["corrupted"] == 0, \
+            f"{r0['corrupted']}/{r0['posted']} in-flight buffers mutated"
+        # broadcast: the root returns before the fan-out frames drain;
+        # mutating the returned/input tensor must not corrupt them
+        r0, _ = ray_tpu.get([
+            actors[0].op_capture_posted.remote("broadcast", data[0], {}),
+            actors[1].broadcast_kw.remote(data[1], 0, {})])
+        assert r0["posted"] > 0
+        assert r0["corrupted"] == 0, \
+            f"{r0['corrupted']}/{r0['posted']} broadcast frames mutated"
+    finally:
+        for a in actors:
+            ray_tpu.kill(a)
+
+
+def test_allgather_int8_symmetric_across_ranks(members):
+    """Quantized allgather is symmetric: every rank sees the IDENTICAL
+    list, each entry being the owner's single quantize->dequantize round
+    trip in the owner's dtype (the own entry is not kept exact — that
+    made list entries differ per rank)."""
+    rng = np.random.default_rng(37)
+    data = [rng.uniform(-1.0, 1.0, 300).astype(np.float32)
+            for _ in range(WORLD)]
+    outs = ray_tpu.get([a.allgather_kw.remote(data[i], {"quant": "int8"})
+                        for i, a in enumerate(members)])
+    for o in outs:
+        for r in range(WORLD):
+            assert o[r].dtype == np.float32
+            # one quant stage per entry, inputs in [-1, 1]
+            assert float(np.abs(o[r] - data[r]).max()) <= 1.0 / 254 + 1e-6
+    for o in outs[1:]:
+        for r in range(WORLD):
+            np.testing.assert_array_equal(o[r], outs[0][r])
