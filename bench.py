@@ -442,6 +442,45 @@ def main() -> None:
         except Exception as e:
             result["collective_error"] = repr(e)
 
+    # Failure-recovery rows (ISSUE 9): chaos-engine-scheduled worker kill
+    # mid sync task + rank kill mid-allreduce (world 4), timing detection
+    # and recovery so regressions in the fault paths show up as numbers.
+    if os.environ.get("RAY_TPU_BENCH_RECOVERY", "1") != "0":
+        import subprocess
+        import sys
+
+        code = ("import json, ray_tpu; from ray_tpu._private.ray_perf "
+                "import host_cpu_count; "
+                "from ray_tpu._private.recovery_bench "
+                "import run_recovery_bench; "
+                "ray_tpu.init(num_cpus=max(host_cpu_count(), 5), "
+                "object_store_memory=1024**3); "
+                "print('RECOVERY=' + json.dumps(run_recovery_bench()))")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        try:
+            proc = subprocess.Popen([sys.executable, "-c", code],
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True,
+                                    env=env, start_new_session=True)
+            try:
+                stdout, stderr = proc.communicate(timeout=300)
+            except subprocess.TimeoutExpired:
+                import signal
+
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait()
+                raise
+            for line in stdout.splitlines():
+                if line.startswith("RECOVERY="):
+                    result["recovery"] = json.loads(
+                        line[len("RECOVERY="):])
+                    break
+            else:
+                result["recovery_error"] = (stderr or "no output")[-500:]
+        except Exception as e:
+            result["recovery_error"] = repr(e)
+
     # Lint gate wall-clock (ISSUE 5): `ray_tpu lint` runs as a tier-1 test
     # on every PR; record its full-tree cost so the gate visibly stays
     # inside its < 10 s CPU budget instead of quietly becoming the slow

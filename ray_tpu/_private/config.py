@@ -121,8 +121,28 @@ _d("gcs_storage_path", str, "", "sqlite file for GCS persistence; empty = in-mem
 _d("gcs_reconnect_timeout_s", float, 60.0, "nodelets/workers retry the GCS connection this long")
 _d("gcs_restart_actor_grace_s", float, 10.0, "restarted GCS waits this long for nodes to re-report actors before declaring them failed")
 _d("task_max_retries_default", int, 3, "default retries for tasks (on worker/node death)")
+_d("task_retry_backoff_s", float, 0.4,
+   "base delay before resubmitting a task whose worker/node died; doubles "
+   "per attempt with +/-25% jitter so a retry storm cannot hammer a node "
+   "that is still shedding load (error-result retries resubmit "
+   "immediately: the worker is healthy).  0 restores immediate resubmit")
+_d("task_retry_backoff_max_s", float, 5.0,
+   "cap on the exponential task-retry backoff")
 _d("max_lease_spillbacks", int, 4, "max times one lease request hops between nodelets before it must settle")
 _d("actor_max_restarts_default", int, 0, "default actor restarts")
+
+# --- Chaos engine (fault injection; see _private/fault_injection.py) ---
+_d("chaos_schedule", str, "",
+   "seeded fault-injection schedule, e.g. "
+   "'seed=7;worker.pre_exec=kill@2;rpc.frame.send[col_]=drop@p0.05'; "
+   "empty (the default) disables every injection point at one attribute "
+   "check of cost")
+_d("chaos_trace_file", str, "",
+   "append each fired injection ('point[detail]#hit:action') to this file "
+   "so cross-process determinism can be asserted; empty keeps the trace "
+   "in-process only")
+_d("chaos_delay_ms", int, 25,
+   "duration of the 'delay' action on rpc.frame.send")
 
 # --- Memory monitor ---
 _d("memory_monitor_refresh_ms", int, 1000, "node memory pressure check period; 0 disables")
@@ -184,6 +204,14 @@ _d("collective_op_timeout_s", float, 300.0, "single collective op timeout")
 _d("collective_default_timeout_s", float, 300.0,
    "default timeout_s for recv/barrier (and the other collectives); on "
    "expiry CollectiveTimeout names the group, op, and lagging rank(s)")
+_d("collective_liveness_grace_s", float, 2.0,
+   "how long a collective recv may sit empty-handed before probing the "
+   "waited-on rank for liveness (progress-stamp freshness, then a TCP "
+   "probe); a dead rank then raises CollectiveWorkerDied naming it "
+   "instead of burning the full op timeout.  <= 0 disables probing")
+_d("collective_liveness_interval_s", float, 2.0,
+   "minimum spacing between liveness probes of the same rank while a "
+   "recv keeps waiting (probes are sockets + KV reads; don't spam them)")
 _d("collective_pipeline", bool, True,
    "pipelined ring data path: fire-and-forget chunked sends overlapped "
    "with recv+reduce; off = the legacy serial blocking-send ring "

@@ -39,7 +39,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
-from ray_tpu._private import rpc
+from ray_tpu._private import fault_injection, rpc
 from ray_tpu._private.config import RayConfig
 from ray_tpu._private.ids import (ACTOR_ID_UNIQUE_BYTES, ActorID, JobID,
                                   NodeID, ObjectID, TaskID, WorkerID,
@@ -137,6 +137,9 @@ class CoreWorker:
         # driver side: tasks the user cancelled (suppresses retry-on-death
         # when force-cancel kills the worker mid-task)
         self._cancelled_tasks: set = set()
+        # workers the nodelet warned us it is pressure-killing: their
+        # 'lost' completions retry for free (worker_id -> warn time)
+        self._pressure_killed: dict = {}
         # GC-safe release pipeline: ObjectRef.__del__ only appends here
         # (deque ops are reentrancy-safe); the IO loop drains
         self._release_queue: deque = deque()
@@ -231,6 +234,10 @@ class CoreWorker:
         self._subscriptions: Dict[str, List] = {}
 
         self.submitter = NormalTaskSubmitter(self)
+        if mode != "worker":
+            # drivers: a dying LOCAL nodelet must invalidate cached leases
+            # too (workers instead treat it as their own death, above)
+            self.nodelet_conn._on_close = self.submitter._on_nodelet_conn_lost
         self.actor_submitters: Dict[ActorID, ActorTaskSubmitter] = {}
 
         self._fn_cache: Dict[Any, Any] = {}
@@ -1104,6 +1111,19 @@ class CoreWorker:
         """Nodelet hint: the store hit full during an extent lease — hand
         back idle leased extents so the requester's retry succeeds."""
         self.plasma.return_idle_extents(force=True)
+        return True
+
+    async def rpc_pressure_kill(self, conn, msg):
+        """Nodelet heads-up: it is about to SIGKILL one of our leased
+        workers to relieve memory pressure.  Mark the worker so its
+        'lost' completions retry without consuming the tasks' crash-retry
+        budget (reference: memory-monitor kills are charged to a separate
+        OOM-retry counter, not max_retries)."""
+        now = time.monotonic()
+        self._pressure_killed = {
+            w: t for w, t in self._pressure_killed.items()
+            if now - t < 60.0}
+        self._pressure_killed[msg["worker_id"]] = now
         return True
 
     # ----------------------------------------------- live introspection
@@ -2194,12 +2214,18 @@ class CoreWorker:
             # creation (dedicated workers).
             t0 = time.time()
             args, kwargs = self._resolve_args(spec)
+            if fault_injection.ENABLED and fault_injection.hit(
+                    "worker.pre_exec", detail=spec.name) == "kill":
+                fault_injection.kill_self()
             if self._race_guard is not None and self.actor_instance is not None:
                 with self._race_guard(self.actor_instance,
                                       spec.actor_method_name or spec.name):
                     out = fn(*args, **kwargs)
             else:
                 out = fn(*args, **kwargs)
+            if fault_injection.ENABLED and fault_injection.hit(
+                    "worker.post_exec", detail=spec.name) == "kill":
+                fault_injection.kill_self()
             t1 = time.time()
             result = self._pack_returns(spec, out)
             t2 = time.time()
@@ -2691,8 +2717,33 @@ class NormalTaskSubmitter:
         conn = self.cw._nodelet_conns.get(tuple(addr))
         if conn is None or conn.closed:
             conn = await rpc.connect(*addr, name=f"->nodelet-{addr[1]}")
+            # node-death crash consistency: cached idle leases pointing at
+            # a dead nodelet must leave circulation the moment the conn
+            # drops, or the next burst pushes tasks into a black hole
+            conn._on_close = self._on_nodelet_conn_lost
             self.cw._nodelet_conns[tuple(addr)] = conn
         return conn
+
+    def _on_nodelet_conn_lost(self, conn) -> None:
+        """Runs on the IO loop when a remote nodelet's connection drops
+        (node death / nodelet crash).  Invalidate every cached lease granted
+        by that nodelet: mark them returned (so _pump and _push_one skip
+        them) and re-pump each affected class so queued work re-leases on a
+        surviving node."""
+        for addr, c in list(self.cw._nodelet_conns.items()):
+            if c is conn:
+                self.cw._nodelet_conns.pop(addr, None)
+        for key, st in list(self.classes.items()):
+            dead = [l for l in st["idle"] if l.get("nodelet_conn") is conn]
+            if not dead:
+                continue
+            for lease in dead:
+                lease["returned"] = True
+            st["idle"] = [l for l in st["idle"]
+                          if l.get("nodelet_conn") is not conn]
+            logger.info("dropped %d cached lease(s) from dead nodelet %s",
+                        len(dead), conn.name)
+            self._schedule_pump(key, st)
 
     async def _request_lease(self, key, st):
         import uuid
@@ -2852,6 +2903,11 @@ class NormalTaskSubmitter:
         was_cancelled = tkey in self.cw._cancelled_tasks
         self.cw._cancelled_tasks.discard(tkey)
         if item["status"] == "ok":
+            lost_at = getattr(spec, "_lost_at", None)
+            if lost_at is not None:
+                spec._lost_at = None
+                fault_injection.observe_recovery(
+                    "task_retry", time.monotonic() - lost_at)
             self.cw._observe_phases(spec, item)
             self.cw.complete_task(spec, item["returns"], holds)
         elif item["status"] == "error":
@@ -2875,18 +2931,24 @@ class NormalTaskSubmitter:
                            for oid in spec.return_ids()], holds)
         else:  # "lost": the worker connection died mid-task
             worker_ok = False
+            # a deliberate memory-monitor kill (nodelet warned us first)
+            # retries for free: pressure must not exhaust max_retries
+            pressure = lease.get("worker_id") in self.cw._pressure_killed
             if was_cancelled:
                 # force-cancel killed the worker: cancelled, never retried
                 self.cw.fail_task(spec, TaskCancelledError(
                     f"task {spec.name} was cancelled (force)"), holds)
-            elif spec.attempt_number < spec.max_retries:
-                spec.attempt_number += 1
+            elif pressure or spec.attempt_number < spec.max_retries:
+                if not pressure:
+                    spec.attempt_number += 1
                 spec.span_id = _fast_unique(8).hex()  # span per attempt
                 spec.phase_ts = {"submit": time.time(), "ser": 0.0}
+                if getattr(spec, "_lost_at", None) is None:
+                    spec._lost_at = time.monotonic()
                 logger.info("retrying task %s (attempt %d) after worker failure",
                             spec.name, spec.attempt_number)
                 self.cw.emit_task_event(spec, "SUBMITTED")
-                st["pending"].append((spec, holds))
+                self._requeue_after_backoff(key, st, spec, holds)
             else:
                 self.cw.fail_task(spec, WorkerCrashedError(
                     f"worker died while running task {spec.name}"), holds)
@@ -2898,6 +2960,29 @@ class NormalTaskSubmitter:
         elif not worker_ok and any(l is lease for l in st["idle"]):
             st["idle"] = [l for l in st["idle"] if l is not lease]
         self._schedule_pump(key, st)
+
+    def _requeue_after_backoff(self, key, st, spec: TaskSpec, holds) -> None:
+        """Re-enqueue a task whose worker/node died, after an exponential
+        backoff with jitter (runs on the IO loop).  Immediate resubmission
+        turns one sick node into a retry storm: every attempt lands while
+        the node is still shedding the dead worker's leases/extents and
+        burns through max_retries before recovery (the standing
+        memory-monitor flake was exactly this).  App-error retries skip the
+        delay -- their worker is healthy."""
+        base = RayConfig.task_retry_backoff_s
+        if base <= 0:
+            st["pending"].append((spec, holds))
+            self._schedule_pump(key, st)
+            return
+        delay = min(base * (2 ** max(spec.attempt_number - 1, 0)),
+                    RayConfig.task_retry_backoff_max_s)
+        delay *= 0.75 + random.random() * 0.5  # +/-25% jitter desyncs herds
+
+        def _fire():
+            st["pending"].append((spec, holds))
+            self._schedule_pump(key, st)
+
+        asyncio.get_event_loop().call_later(delay, _fire)
 
     def _schedule_pump(self, key, st) -> None:
         """Coalesce pump wakeups: one per burst of completions, not one per
@@ -3056,7 +3141,17 @@ class ActorTaskSubmitter:
                 else:
                     self._drain_scheduled = True
             if retried:
-                self._start_drain()
+                # backoff before re-driving the reconnect: a gang of handles
+                # hammering get_actor_info the instant an actor dies slows
+                # the very restart they are waiting for
+                base = RayConfig.task_retry_backoff_s
+                if base <= 0:
+                    self._start_drain()
+                else:
+                    delay = min(base, RayConfig.task_retry_backoff_max_s) \
+                        * (0.75 + random.random() * 0.5)
+                    asyncio.get_event_loop().call_later(
+                        delay, self._start_drain)
 
     def _on_actor_update(self, info):
         self.state = info["state"]

@@ -33,7 +33,7 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import rpc
+from ray_tpu._private import fault_injection, rpc
 from ray_tpu._private.config import RayConfig
 from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
 from ray_tpu._private.object_store import PlasmaStore, register_store_handlers
@@ -1003,6 +1003,13 @@ class Nodelet:
         last_mm_check = 0.0
         while True:
             await asyncio.sleep(0.2)
+            # refresh each tick so a schedule armed at runtime (rpc_set_env
+            # test hook) takes effect live; unchanged schedules cost one env
+            # read + string compare
+            fault_injection.refresh()
+            if fault_injection.ENABLED and fault_injection.hit(
+                    "nodelet.tick", detail=self.node_id.hex()) == "kill":
+                fault_injection.kill_self()
             for w in list(self.workers.values()):
                 if w.proc is not None and w.proc.poll() is not None and w.state != "dead":
                     await self._handle_worker_death(w, f"exit code {w.proc.returncode}")
@@ -1029,6 +1036,7 @@ class Nodelet:
                             (frac or 0) * 100,
                             RayConfig.memory_usage_threshold * 100,
                             victim.worker_id.hex()[:8])
+                        await self._notify_pressure_kill(victim)
                         self._kill_worker_proc(victim)
                         await self._handle_worker_death(
                             victim, "killed by the memory monitor: node "
@@ -1092,7 +1100,7 @@ class Nodelet:
     def _on_conn_lost(self, conn: rpc.Connection):
         from ray_tpu._private.object_store import cleanup_client_connection
 
-        cleanup_client_connection(self.store, conn)
+        cleanup_client_connection(self.store, conn, waiters=self.waiters)
         # leases granted to a vanished client (driver death, cached leases
         # included): the workers are healthy — return them to the idle pool
         # instead of stranding them in "leased" forever
@@ -1445,6 +1453,23 @@ class Nodelet:
         conn.context.get("granted_leases", set()).discard(msg["lease_id"])
         self._release_lease(msg["lease_id"])
         return True
+
+    async def _notify_pressure_kill(self, w: WorkerHandle) -> None:
+        """Heads-up to the lease holder BEFORE the SIGKILL: the imminent
+        'lost' completion is a deliberate pressure kill, not a crash, so
+        the submitter retries the task without consuming its crash-retry
+        budget (reference: OOM-killed tasks retry on their own counter,
+        unlimited by default, so pressure can't exhaust max_retries)."""
+        if w.lease_id is None:
+            return
+        for conn in list(self.server.connections):
+            if w.lease_id in conn.context.get("granted_leases", ()):
+                try:
+                    await conn.notify("pressure_kill",
+                                      {"worker_id": w.worker_id})
+                except ConnectionError:
+                    pass
+                return
 
     # ---------------------------------------------------- reclaim hints
     def _hint_lease_reclaim(self) -> None:

@@ -40,6 +40,7 @@ import time
 from multiprocessing import resource_tracker, shared_memory
 from typing import Dict, List, Optional, Set, Tuple
 
+from ray_tpu._private import fault_injection
 from ray_tpu._private.config import RayConfig
 from ray_tpu._private.ids import ObjectID
 from ray_tpu.exceptions import ObjectStoreFullError
@@ -801,6 +802,12 @@ class PlasmaClient:
         frame.  A get racing ahead of the seal parks on the store's waiters
         and resolves when the seal lands (same-connection FIFO bounds the
         window to one tick)."""
+        if fault_injection.ENABLED and fault_injection.hit(
+                "plasma.seal", detail=oid.hex()) == "torn":
+            # torn seal: the bytes were memcpy'd into the leased extent but
+            # the store never learns the oid -- models a client SIGKILLed
+            # in the window between write and seal notify
+            return
         self._conn.notify_coalesced_threadsafe(
             "plasma_seal_extent",
             {"oid": oid.binary(), "slab": slab, "off": off,
@@ -1504,7 +1511,8 @@ def register_store_handlers(handlers: dict, store: PlasmaStore, waiters: dict,
     )
 
 
-def cleanup_client_connection(store: PlasmaStore, conn) -> None:
+def cleanup_client_connection(store: PlasmaStore, conn,
+                              waiters: Optional[dict] = None) -> None:
     """Release a dead client's pins, half-written creates, and leased-but-
     unsealed extents (reference: plasma store disconnect cleanup,
     plasma/store.cc DisconnectClient)."""
@@ -1515,6 +1523,17 @@ def cleanup_client_connection(store: PlasmaStore, conn) -> None:
         e = store.objects.get(oid)
         if e is not None and not e.sealed:
             store.delete(oid)
+            # Crash consistency: gets parked on an object its creator never
+            # sealed must not burn their full timeout -- the primary copy
+            # died with the client.  Waking the future makes plasma_get
+            # re-check the store, find nothing, and return a miss that the
+            # owner-side recovery/retry path handles immediately.
+            if waiters is not None:
+                for fut in waiters.pop(oid, []):
+                    if not fut.done():
+                        fut.set_result(False)
     for slab, runs in conn.context.pop("plasma_extents", {}).items():
+        # leased-but-unsealed extents return to the free list: a SIGKILLed
+        # client's runs are re-leasable by the next client immediately
         for off, ln in runs:
             store.free_extent(slab, off, ln)

@@ -34,6 +34,7 @@ from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
 import msgpack
 
+from ray_tpu._private import fault_injection
 from ray_tpu._private.config import RayConfig
 
 logger = logging.getLogger(__name__)
@@ -163,6 +164,22 @@ class Connection:
     _COALESCE_MAX = 64 * 1024
 
     async def _send_frame(self, header: dict, inband: bytes, buffers: list):
+        if fault_injection.ENABLED:
+            act = fault_injection.hit("rpc.frame.send",
+                                      detail=header.get("m") or "")
+            if act == "drop":
+                return
+            if act == "delay":
+                await asyncio.sleep(fault_injection.delay_s())
+            elif act == "sever":
+                self._writer.close()
+                raise ConnectionLost("chaos: link severed")
+            elif act == "dup":
+                await self._send_frame_raw(header, inband, buffers)
+        await self._send_frame_raw(header, inband, buffers)
+
+    async def _send_frame_raw(self, header: dict, inband: bytes,
+                              buffers: list):
         header_b = msgpack.packb(header)
         async with self._send_lock:
             # Coalesce the small chunks (length prefixes, header, small

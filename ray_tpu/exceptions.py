@@ -166,3 +166,18 @@ class CollectiveTimeout(CollectiveError):
         self.group = group
         self.op = op
         self.lagging_ranks = tuple(lagging_ranks)
+
+
+class CollectiveWorkerDied(CollectiveError):
+    """A group member's process died mid-collective.  Distinguished from a
+    straggler by a liveness probe (stale progress stamp + refused socket),
+    so the caller learns the dead rank in seconds instead of burning the
+    full op timeout.  Recover with ``Group.rebuild()`` (shrink over the
+    survivors, or replace after restarting the rank)."""
+
+    def __init__(self, message: str, group: str = "", op: str = "",
+                 rank: int = -1):
+        super().__init__(message)
+        self.group = group
+        self.op = op
+        self.rank = rank

@@ -517,6 +517,41 @@ def _cmd_lint(args) -> int:
     return 0 if result.ok else 1
 
 
+def _cmd_chaos(args) -> int:
+    """Deterministic fault-injection engine: list the registered injection
+    points, or validate a schedule string before arming a run with it
+    (grammar: ray_tpu/_private/fault_injection.py)."""
+    from ray_tpu._private import fault_injection
+
+    if args.validate is not None:
+        try:
+            st = fault_injection._State(args.validate)
+        except ValueError as e:
+            print(f"invalid schedule: {e}")
+            return 1
+        n = sum(len(rs) for rs in st.rules.values())
+        print(f"schedule ok: seed={st.seed}, {n} rule(s)")
+        for point, rules in sorted(st.rules.items()):
+            for r in rules:
+                trig = f"p={r.prob}" if r.prob is not None else \
+                    f"hit {r.nth}{'+' if r.and_after else ''}"
+                det = f"[{r.detail}]" if r.detail else ""
+                print(f"  {point}{det} -> {r.action} @ {trig}")
+        return 0
+    # default: --list-points
+    rows = fault_injection.describe_points()
+    wn = max(len(r[0]) for r in rows)
+    wa = max(len(r[1]) for r in rows)
+    print(f"{'POINT':<{wn}}  {'ACTIONS':<{wa}}  WHERE (detail)")
+    for name, actions, detail, where in rows:
+        print(f"{name:<{wn}}  {actions:<{wa}}  {where} (detail: {detail})")
+    print()
+    print("schedule: seed=<int>;<point>[<detail-substr>]=<action>@<trigger>")
+    print("trigger:  p<float> | <Nth hit> | <Nth hit>+  "
+          "(env RAY_TPU_CHAOS_SCHEDULE)")
+    return 0
+
+
 def _cmd_up(args) -> int:
     from ray_tpu.autoscaler.launcher import cluster_up
 
@@ -584,6 +619,15 @@ def main(argv=None) -> int:
     p.add_argument("--list-rules", action="store_true",
                    help="print the checker table and exit")
     p.set_defaults(fn=_cmd_lint)
+
+    p = sub.add_parser(
+        "chaos", help="deterministic fault-injection engine: list "
+        "injection points / validate a schedule")
+    p.add_argument("--list-points", action="store_true",
+                   help="enumerate registered injection points (default)")
+    p.add_argument("--validate", default=None, metavar="SCHEDULE",
+                   help="parse a schedule string and print its rules")
+    p.set_defaults(fn=_cmd_chaos)
 
     p = sub.add_parser("status", help="cluster nodes + pending demand")
     p.add_argument("--address", default=None)

@@ -170,7 +170,9 @@ class ProxyActor:
         from ray_tpu.serve._streaming import ResponseStream
 
         if isinstance(out, ResponseStream):
-            return await self._stream_response(request, out, start)
+            return await self._stream_response(
+                request, out, start,
+                retry=lambda: self._route_and_call(path, body))
         self._observe_ingress("http", "200", start)
         if isinstance(out, (dict, list)):
             return web.json_response(out)
@@ -178,12 +180,23 @@ class ProxyActor:
             return web.Response(body=out)
         return web.Response(text=str(out))
 
-    async def _stream_response(self, request, stream, start):
+    async def _stream_response(self, request, stream, start, retry=None):
         """Generator-returning deployment over HTTP: chunked SSE — each
         produced item is one ``data:`` event, flushed as it arrives, so
         token streams reach the client incrementally instead of buffering
-        to completion (reference: serve's StreamingResponse proxying)."""
+        to completion (reference: serve's StreamingResponse proxying).
+
+        Replica-death failover: a stream is replica-affine, so losing the
+        replica BEFORE the first chunk reached the client is invisible to
+        them — re-issue the call once on another replica (``retry``).
+        After the first chunk the output is already partially consumed and
+        a silent re-run would duplicate it: emit a terminal ``event:
+        error`` SSE frame instead of hanging or replaying."""
         from aiohttp import web
+
+        from ray_tpu._private import fault_injection
+        from ray_tpu.exceptions import RayActorError
+        from ray_tpu.serve._streaming import ResponseStream
 
         loop = asyncio.get_event_loop()
         resp = web.StreamResponse(headers={
@@ -193,11 +206,26 @@ class ProxyActor:
         })
         await resp.prepare(request)
         status = "200"
+        wrote_chunk = False
+        retried = False
         try:
             while True:
                 # each pull blocks on the replica long-poll: executor thread
-                items, done = await loop.run_in_executor(
-                    None, stream.next_batch, 30.0)
+                try:
+                    items, done = await loop.run_in_executor(
+                        None, stream.next_batch, 30.0)
+                except RayActorError:
+                    if wrote_chunk or retried or retry is None:
+                        raise  # -> terminal error event below
+                    retried = True
+                    t_fail = time.perf_counter()
+                    out = await loop.run_in_executor(None, retry)
+                    if not isinstance(out, ResponseStream):
+                        raise  # app no longer streams: can't splice it in
+                    stream = out
+                    fault_injection.observe_recovery(
+                        "serve", time.perf_counter() - t_fail)
+                    continue
                 for item in items:
                     if isinstance(item, bytes):
                         payload = item
@@ -206,6 +234,7 @@ class ProxyActor:
                     else:
                         payload = json.dumps(item).encode()
                     await resp.write(b"data: " + payload + b"\n\n")
+                    wrote_chunk = True
                 if done:
                     await resp.write(b"data: [DONE]\n\n")
                     break

@@ -21,6 +21,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
+from ray_tpu._private import fault_injection
 from ray_tpu.train._checkpoint import Checkpoint
 
 _session_lock = threading.Lock()
@@ -135,6 +136,13 @@ class _TrainSession:
             t0 = _time.perf_counter()
             persisted = self._persist_checkpoint(checkpoint)
             m["ckpt_persist"].observe(_time.perf_counter() - t0, labels)
+        if fault_injection.ENABLED and fault_injection.hit(
+                "train.report",
+                detail=self.context.experiment_name or "") == "kill":
+            # dies AFTER the checkpoint persisted but before the result
+            # reaches the driver: the restore path must treat the persisted
+            # dir as durable only once every rank's report round-tripped
+            fault_injection.kill_self()
         self._result_q.put(_TrainingResult(dict(metrics), persisted))
         self._consumed.acquire()  # lockstep with the driver (reference :403)
 

@@ -24,12 +24,14 @@ from ray_tpu.util.collective.collective import (
     init_collective_group,
     recv,
     reducescatter,
+    rejoin_collective_group,
     send,
 )
 from ray_tpu.util.collective import quantization, topology, xla
 
 __all__ = [
-    "init_collective_group", "destroy_collective_group", "allreduce",
+    "init_collective_group", "rejoin_collective_group",
+    "destroy_collective_group", "allreduce",
     "allgather", "reducescatter", "broadcast", "send", "recv", "barrier",
     "get_rank", "get_collective_group_size", "get_group_progress",
     "quantization", "topology", "xla",

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import socket
 import threading
 import time
 import uuid
@@ -49,10 +50,14 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ray_tpu._private import rpc
+from ray_tpu._private import fault_injection, rpc
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.config import RayConfig
-from ray_tpu.exceptions import CollectiveError, CollectiveTimeout
+from ray_tpu.exceptions import (
+    CollectiveError,
+    CollectiveTimeout,
+    CollectiveWorkerDied,
+)
 from ray_tpu.util.collective import shm_channel as shm_ch
 from ray_tpu.util.collective import topology as topo_mod
 from ray_tpu.util.collective.quantization import (
@@ -88,12 +93,17 @@ def _check_quant(quant: Optional[str]) -> None:
 
 
 class Group:
-    def __init__(self, name: str, world_size: int, rank: int):
+    def __init__(self, name: str, world_size: int, rank: int, gen: int = 0):
         self.name = name
         self.world_size = world_size
         self.rank = rank
         self.core = worker_mod.require_core()
         self.seq = 0
+        # Generation counter, bumped by rebuild().  Gen > 0 incarnations
+        # live under a distinct KV prefix AND handler name, so frames still
+        # in flight from a dead incarnation land on a missing handler and
+        # drop instead of corrupting the re-formed group.
+        self._gen = gen
         # key -> FIFO of payloads.  A queue (not a single slot) so two p2p
         # sends with the same (src, tag) before the receiver consumes the
         # first don't overwrite each other (round-1 advisor bug); message
@@ -103,9 +113,13 @@ class Group:
         self._inbox_cv = threading.Condition()
         self._member_addrs: Dict[int, tuple] = {}
         self._member_nodes: Dict[int, str] = {}
-        handler_name = f"col_{name}"
-        self.core.server.handlers[handler_name] = self._on_message
-        self._handler_name = handler_name
+        # Ranks a liveness probe declared dead: every further send/recv
+        # involving them short-circuits to CollectiveWorkerDied instead of
+        # re-discovering the death one timeout at a time.
+        self._dead_ranks: set = set()
+        self._last_probe: Dict[int, float] = {}
+        self._handler_name = self._handler_basename()
+        self.core.server.handlers[self._handler_name] = self._on_message
         # Test hook: artificial delay of the handler ACK (data delivery is
         # NOT delayed).  Models a peer whose reply path lags — the pipelined
         # data plane must not care; the legacy blocking-send ring stalls a
@@ -149,17 +163,32 @@ class Group:
     def _kv(self, op, **kw):
         return self.core.io.run(self.core.gcs_conn.call(op, kw))
 
-    def _register(self):
+    def _handler_basename(self) -> str:
+        return f"col_{self.name}" if self._gen == 0 \
+            else f"col_{self.name}@g{self._gen}"
+
+    @property
+    def _prefix(self) -> str:
+        """KV key prefix for this incarnation.  Gen 0 keeps the historical
+        layout; rebuilt generations get their own namespace (NOT nested
+        under ``collective/<name>/`` — a stale-generation key must never
+        count toward a later rendezvous's membership tally)."""
+        return f"collective/{self.name}" if self._gen == 0 \
+            else f"collective/{self.name}@g{self._gen}"
+
+    def _register(self, timeout_s: Optional[float] = None):
         import pickle
 
-        key = f"collective/{self.name}/{self.rank}"
+        key = f"{self._prefix}/{self.rank}"
         node = getattr(self.core, "_node_id_hex", None) \
             or f"host-{self.core.addr[0]}"
         rec = pickle.dumps({"addr": tuple(self.core.addr), "node": node})
         self._kv("kv_put", ns="collective", key=key, value=rec, overwrite=True)
-        deadline = time.monotonic() + RayConfig.collective_rendezvous_timeout_s
+        deadline = time.monotonic() + (
+            RayConfig.collective_rendezvous_timeout_s
+            if timeout_s is None else timeout_s)
         while True:
-            keys = self._kv("kv_keys", ns="collective", prefix=f"collective/{self.name}/")
+            keys = self._kv("kv_keys", ns="collective", prefix=f"{self._prefix}/")
             if len(keys) >= self.world_size:
                 break
             if time.monotonic() > deadline:
@@ -168,9 +197,9 @@ class Group:
                     f"{self.world_size} members after rendezvous timeout")
             time.sleep(0.05)
         vals = self._kv("kv_multi_get", ns="collective",
-                        keys=[f"collective/{self.name}/{r}" for r in range(self.world_size)])
+                        keys=[f"{self._prefix}/{r}" for r in range(self.world_size)])
         for r in range(self.world_size):
-            loaded = pickle.loads(vals[f"collective/{self.name}/{r}"])
+            loaded = pickle.loads(vals[f"{self._prefix}/{r}"])
             if isinstance(loaded, dict):
                 self._member_addrs[r] = tuple(loaded["addr"])
                 self._member_nodes[r] = loaded.get("node") or f"rank-{r}"
@@ -205,10 +234,18 @@ class Group:
         and the ``collective_pipeline=False`` serial ring use it."""
         timeout = RayConfig.collective_op_timeout_s if deadline is None \
             else max(deadline - time.monotonic(), 0.001)
-        self._conn(rank).call_sync(
-            self._handler_name,
-            {"seq": seq, "src": self.rank, "tag": tag, "data": data},
-            timeout=timeout)
+        try:
+            self._conn(rank).call_sync(
+                self._handler_name,
+                {"seq": seq, "src": self.rank, "tag": tag, "data": data},
+                timeout=timeout)
+        except (rpc.ConnectionLost, ConnectionError) as e:
+            self._dead_ranks.add(rank)
+            raise CollectiveWorkerDied(
+                f"collective group {self.name!r}: blocking send to rank "
+                f"{rank} failed ({e!r}) — peer link severed; recover with "
+                f"Group.rebuild()",
+                group=self.name, op="send", rank=rank) from e
 
     def _post_send(self, rank: int, data, seq: int, tag: int = 0):
         """Fire-and-forget pipelined send.  Per-connection ordering is
@@ -219,13 +256,20 @@ class Group:
                 self._handler_name,
                 {"seq": seq, "src": self.rank, "tag": tag, "data": data})
         except (rpc.ConnectionLost, ConnectionError, OSError) as e:
-            raise CollectiveError(
+            self._dead_ranks.add(rank)
+            raise CollectiveWorkerDied(
                 f"collective group {self.name!r}: send to rank {rank} "
-                f"failed ({e!r})") from e
+                f"failed ({e!r}) — peer link severed; recover with "
+                f"Group.rebuild()",
+                group=self.name, op="send", rank=rank) from e
 
     def _send_payload(self, rank: int, payload, seq: int, tag: int,
                       deadline: Optional[float], pipelined: bool,
                       shm_ok: bool = True):
+        if rank in self._dead_ranks:
+            # a probe already declared this peer dead: don't queue frames
+            # into a severed link (or re-burn a blocking-send timeout)
+            raise self._dead_error("send", rank)
         detached = False
         if shm_ch.is_desc(payload) and self._member_nodes.get(rank) != \
                 self._member_nodes.get(self.rank):
@@ -299,31 +343,42 @@ class Group:
         key = (seq, rank, tag)
         if deadline is None:
             deadline = time.monotonic() + RayConfig.collective_op_timeout_s
-        with self._inbox_cv:
-            while not self._inbox.get(key):
+        grace = RayConfig.collective_liveness_grace_s
+        started = time.monotonic()
+        while True:
+            with self._inbox_cv:
+                q = self._inbox.get(key)
+                if q:
+                    data = q.popleft()
+                    if not q:
+                        del self._inbox[key]
+                    # raw=True hands back a possible shm descriptor
+                    # unresolved so relays can forward it without
+                    # re-placing the bytes
+                    return data if raw else self._shm_resolve(data)
                 remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                self._inbox_cv.wait(min(remaining, 1.0))
-            else:
-                q = self._inbox[key]
-                data = q.popleft()
-                if not q:
-                    del self._inbox[key]
-                # raw=True hands back a possible shm descriptor unresolved
-                # so relays can forward it without re-placing the bytes
-                return data if raw else self._shm_resolve(data)
-        # timed out: diagnose OUTSIDE the condition lock — naming the
-        # lagging rank costs a KV read and must not block inbox delivery
-        raise self._timeout_error(op, rank)
+                if remaining > 0:
+                    self._inbox_cv.wait(min(remaining, 1.0))
+            if remaining <= 0:
+                # timed out: diagnose OUTSIDE the condition lock — naming
+                # the lagging rank costs a KV read and must not block
+                # inbox delivery
+                raise self._timeout_error(op, rank)
+            if grace > 0 and time.monotonic() - started >= grace:
+                # still empty-handed past the grace window: decide
+                # dead-vs-straggler (also outside the lock — the probe
+                # does a KV read and a socket connect)
+                self._probe_liveness(rank, op)
 
     def _recv_any(self, seq: int, tag: int, ranks: Sequence[int],
                   deadline: float, op: str = "recv"):
         """Wait for a message from ANY of ``ranks`` (quorum gather: arrival
         order decides membership).  Returns (rank, payload)."""
         keys = {r: (seq, r, tag) for r in ranks}
-        with self._inbox_cv:
-            while True:
+        grace = RayConfig.collective_liveness_grace_s
+        started = time.monotonic()
+        while True:
+            with self._inbox_cv:
                 for r, key in keys.items():
                     q = self._inbox.get(key)
                     if q:
@@ -332,10 +387,18 @@ class Group:
                             del self._inbox[key]
                         return r, self._shm_resolve(data)
                 remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                self._inbox_cv.wait(min(remaining, 1.0))
-        raise self._timeout_error(op, min(ranks))
+                if remaining > 0:
+                    self._inbox_cv.wait(min(remaining, 1.0))
+            if remaining <= 0:
+                raise self._timeout_error(op, min(ranks))
+            if grace > 0 and time.monotonic() - started >= grace:
+                # an any-wait tolerates individual deaths (that is the
+                # point of quorum reduce): only when EVERY candidate is
+                # dead can no message ever arrive
+                dead = [r for r in ranks
+                        if not self._probe_liveness(r, op, raise_dead=False)]
+                if len(dead) == len(list(ranks)):
+                    raise self._dead_error(op, dead[0])
 
     def _try_pop(self, seq: int, rank: int, tag: int):
         """Non-blocking inbox pop (quorum late-contribution drain)."""
@@ -360,7 +423,7 @@ class Group:
         try:
             self.core.io.spawn(self.core.gcs_conn.notify("kv_put", {
                 "ns": "collective",
-                "key": f"collective/{self.name}/progress/{self.rank}",
+                "key": f"{self._prefix}/progress/{self.rank}",
                 "value": pickle.dumps(
                     {"seq": seq, "op": op, "ts": time.time()}),
                 "overwrite": True,
@@ -375,11 +438,11 @@ class Group:
 
         vals = self._kv(
             "kv_multi_get", ns="collective",
-            keys=[f"collective/{self.name}/progress/{r}"
+            keys=[f"{self._prefix}/progress/{r}"
                   for r in range(self.world_size)])
         out: Dict[int, dict] = {}
         for r in range(self.world_size):
-            blob = vals.get(f"collective/{self.name}/progress/{r}")
+            blob = vals.get(f"{self._prefix}/progress/{r}")
             if blob is not None:
                 out[r] = pickle.loads(blob)
         return out
@@ -402,6 +465,114 @@ class Group:
             f"lagging: {detail}",
             group=self.name, op=op,
             lagging_ranks=lagging or [waiting_on])
+
+    # -------------------------------------------------- liveness / rank death
+    def _probe_liveness(self, rank: int, op: str,
+                        raise_dead: bool = True) -> bool:
+        """Decide dead-vs-straggler for a rank we are stuck waiting on.
+        Runs OUTSIDE the inbox lock.  Evidence, in order:
+
+        1. a progress stamp fresher than the grace window → alive (fast
+           path; piggybacks on the KV heartbeat every op start writes);
+        2. a TCP connect to the rank's server address: accepted or timed
+           out → alive (a straggler's host is up even when its Python is
+           wedged); refused/unreachable → DEAD.
+
+        A dead rank raises CollectiveWorkerDied naming it — in seconds,
+        not after the full op timeout — or returns False for
+        ``raise_dead=False`` callers (the quorum any-wait, which tolerates
+        individual deaths).  Returns True when the rank is alive or the
+        probe is rate-limited.
+
+        Confirmed deaths are PUBLISHED to the KV (``<prefix>/dead/<rank>``):
+        in a ring only the dead rank's downstream neighbor starves on it
+        directly — every other rank is stuck waiting on a live peer that
+        already raised and moved on, and would otherwise burn the full op
+        timeout.  The shared dead-set makes all survivors converge on the
+        same CollectiveWorkerDied within one probe interval."""
+        if rank in self._dead_ranks:
+            if raise_dead:
+                raise self._dead_error(op, rank)
+            return False
+        now = time.monotonic()
+        if now - self._last_probe.get(rank, 0.0) < \
+                RayConfig.collective_liveness_interval_s:
+            return True  # probed recently; it was not dead then
+        self._last_probe[rank] = now
+        # deaths a peer already proved: a full collective cannot complete
+        # with ANY member gone, so raise on those even when the rank WE
+        # wait on is alive (raise_dead=False callers care only about their
+        # own candidate set and keep per-rank semantics)
+        published = self._kv_dead()
+        if published:
+            self._dead_ranks.update(published)
+            if raise_dead:
+                raise self._dead_error(
+                    op, rank if rank in published else min(published))
+            return rank not in published
+        try:
+            stamp = self.progress().get(rank)
+        except Exception:
+            stamp = None  # KV unreachable: fall through to the TCP probe
+        if stamp is not None and time.time() - stamp.get("ts", 0.0) < \
+                max(RayConfig.collective_liveness_grace_s,
+                    RayConfig.collective_liveness_interval_s):
+            return True
+        if self._probe_addr(self._member_addrs.get(rank)):
+            return True
+        self._dead_ranks.add(rank)
+        self._publish_dead(rank)
+        if raise_dead:
+            raise self._dead_error(op, rank)
+        return False
+
+    def _kv_dead(self) -> set:
+        """Ranks any member has proven dead this generation (KV-shared)."""
+        try:
+            keys = self._kv("kv_keys", ns="collective",
+                            prefix=f"{self._prefix}/dead/")
+        except Exception:
+            return set()
+        out = set()
+        for k in keys:
+            try:
+                out.add(int(k.rsplit("/", 1)[1]))
+            except ValueError:
+                pass
+        out.discard(self.rank)
+        return out
+
+    def _publish_dead(self, rank: int) -> None:
+        try:
+            self._kv("kv_put", ns="collective",
+                     key=f"{self._prefix}/dead/{rank}", value=b"1",
+                     overwrite=True)
+        except Exception:
+            pass  # peers will re-prove the death with their own probes
+
+    @staticmethod
+    def _probe_addr(addr, timeout: float = 1.0) -> bool:
+        """True if something is listening at ``addr`` — or merely slow (a
+        straggler must never be declared dead, so a connect TIMEOUT counts
+        as alive).  False only on a definitive refusal/unreachable."""
+        if addr is None:
+            return False
+        try:
+            socket.create_connection(tuple(addr), timeout=timeout).close()
+            return True
+        except socket.timeout:
+            return True
+        except OSError:
+            return False
+
+    def _dead_error(self, op: str, rank: int) -> CollectiveWorkerDied:
+        return CollectiveWorkerDied(
+            f"collective {op!r} in group {self.name!r} (rank {self.rank}, "
+            f"seq {self.seq}): rank {rank} DIED mid-collective (progress "
+            f"stamp stale and {self._member_addrs.get(rank)} refuses "
+            f"connections) — recover with Group.rebuild() after restarting "
+            f"or excluding it",
+            group=self.name, op=op, rank=rank)
 
     # ----------------------------------------------------- per-op accounting
     def _begin_op(self, op: str) -> int:
@@ -506,6 +677,12 @@ class Group:
                 first.size, first.itemsize, pipelined)):
             self._send_payload(right, self._maybe_quant(first[s:e], quant),
                                seq, _TAG_RS + w, deadline, pipelined)
+        if fault_injection.ENABLED and fault_injection.hit(
+                "collective.step", detail=f"rank{self.rank}") == "kill":
+            # mid-collective rank death: our first ring step is already on
+            # the wire, so peers' recvs from us starve — their liveness
+            # probes must convert that into CollectiveWorkerDied
+            fault_injection.kill_self()
         for step in range(n - 1):
             fl = flats[(pos - step - 1 + shift) % n]
             for w, (s, e) in enumerate(self._wire_bounds(
@@ -900,11 +1077,78 @@ class Group:
             self._shm_tx = None
         self._shm_rx.close()
         if self.rank == 0:
-            try:
-                self._kv("kv_del", ns="collective", key=f"collective/{self.name}/",
-                         prefix=True)
-            except Exception:
-                pass
+            # the "@" prefix sweeps every rebuilt generation's keys (and
+            # the gen pointer lives under the base prefix)
+            for prefix in (f"collective/{self.name}/",
+                           f"collective/{self.name}@"):
+                try:
+                    self._kv("kv_del", ns="collective", key=prefix,
+                             prefix=True)
+                except Exception:
+                    pass
+
+    # -------------------------------------------------------------- recovery
+    def rebuild(self, world_size: Optional[int] = None,
+                rank: Optional[int] = None,
+                timeout_s: Optional[float] = None) -> "Group":
+        """Re-form the group after a member died mid-collective.
+
+        **Shrink** (default, no args): probe every old member address, keep
+        the survivors, renumber ranks by old-rank order — this rank's new
+        rank is its index among the survivors.  **Replace**: pass the old
+        ``world_size`` and this rank's (unchanged) ``rank`` explicitly on
+        every survivor, restart the dead rank's process, and have it call
+        :func:`rejoin_collective_group` — it reads the new generation from
+        the KV and registers under it.
+
+        The rebuilt group lives under a bumped GENERATION: fresh KV prefix
+        (``collective/<name>@g<gen>``) and handler name, so frames still in
+        flight from the dead incarnation land on a missing handler and are
+        dropped instead of corrupting the new one.  All per-op state (seq,
+        inbox, quorum parkings, shm arenas) resets — ops on the rebuilt
+        group are bitwise-identical to a freshly initialized group of the
+        same membership."""
+        t0 = time.monotonic()
+        if world_size is None or rank is None:
+            survivors = [r for r in sorted(self._member_addrs)
+                         if r == self.rank
+                         or (r not in self._dead_ranks
+                             and self._probe_addr(self._member_addrs[r]))]
+            world_size = len(survivors) if world_size is None else world_size
+            rank = survivors.index(self.rank) if rank is None else rank
+        # tear down the dead incarnation
+        self.core.server.handlers.pop(self._handler_name, None)
+        with self._inbox_cv:
+            self._inbox.clear()
+        if self._shm_tx is not None:
+            self._shm_tx.close()
+            self._shm_tx = None
+        self._shm_rx.close()
+        self._shm_rx = shm_ch.RxCache()
+        self._quorum_pending = []
+        self.last_quorum_late = []
+        self._dead_ranks.clear()
+        self._last_probe.clear()
+        self._member_addrs.clear()
+        self._member_nodes.clear()
+        # bring up the next generation
+        self._gen += 1
+        self.world_size = world_size
+        self.rank = rank
+        self.seq = 0
+        self._handler_name = self._handler_basename()
+        self.core.server.handlers[self._handler_name] = self._on_message
+        try:
+            # advertise the generation so a restarted rank can rejoin
+            self._kv("kv_put", ns="collective",
+                     key=f"collective/{self.name}/gen",
+                     value=str(self._gen).encode(), overwrite=True)
+        except Exception:
+            pass
+        self._register(timeout_s)
+        self._stamp_progress("rebuild", 0)
+        fault_injection.observe_recovery("collective", time.monotonic() - t0)
+        return self
 
 
 def _payload_bytes(payload) -> int:
@@ -930,6 +1174,41 @@ def init_collective_group(world_size: int, rank: int, backend: str = "cpu",
         if group_name in _groups:
             raise RuntimeError(f"collective group {group_name!r} already initialized")
         _groups[group_name] = Group(group_name, world_size, rank)
+
+
+def rejoin_collective_group(world_size: int, rank: int, backend: str = "cpu",
+                            group_name: str = "default") -> None:
+    """Join a group that surviving members re-formed with
+    :meth:`Group.rebuild` (replace mode).  Polls the KV for the group's
+    current generation (written by the survivors' rebuild), then registers
+    under it.  The restarted process keeps the dead rank's number; the
+    survivors must have passed the full ``world_size`` to ``rebuild`` so
+    their rendezvous waits for this rank."""
+    if backend not in ("cpu", "gloo", "xla"):
+        raise ValueError(f"unsupported backend {backend!r}; use 'cpu' or 'xla'")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    core = worker_mod.require_core()
+    key = f"collective/{group_name}/gen"
+    deadline = time.monotonic() + RayConfig.collective_rendezvous_timeout_s
+    while True:
+        blob = core.io.run(core.gcs_conn.call(
+            "kv_get", {"ns": "collective", "key": key}))
+        if blob:
+            gen = int(bytes(blob).decode())
+            break
+        if time.monotonic() > deadline:
+            raise CollectiveError(
+                f"rejoin_collective_group({group_name!r}): no rebuilt "
+                f"generation advertised in the KV after "
+                f"{RayConfig.collective_rendezvous_timeout_s}s — did the "
+                f"survivors call Group.rebuild()?")
+        time.sleep(0.1)
+    with _lock:
+        # a pre-crash handle in this process (rejoin without restart) is
+        # stale: its handler name belongs to the dead generation anyway
+        _groups.pop(group_name, None)
+        _groups[group_name] = Group(group_name, world_size, rank, gen=gen)
 
 
 def _group(group_name: str) -> Group:
