@@ -481,6 +481,45 @@ def main() -> None:
         except Exception as e:
             result["recovery_error"] = repr(e)
 
+    # Pipeline-parallel A/B (ISSUE 10): tiny-GPT-2 tokens/sec, 1-stage vs
+    # 2-stage 1F1B at M in {1,4,8}, interleaved rounds with min-of-rounds,
+    # measured bubble fraction next to the theoretical (S-1)/(S-1+M) and
+    # the overlap-accounted projection for boxes that serialize the stages.
+    # Subprocess so the forced 1-device CPU jax config can't leak into the
+    # headline TPU measurement.
+    if os.environ.get("RAY_TPU_BENCH_PIPELINE", "1") != "0":
+        import subprocess
+        import sys
+
+        code = ("import json; from ray_tpu._private.pipeline_bench "
+                "import run_pipeline_bench; "
+                "print('PIPELINE=' + json.dumps(run_pipeline_bench()))")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        try:
+            proc = subprocess.Popen([sys.executable, "-c", code],
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True,
+                                    env=env, start_new_session=True)
+            try:
+                stdout, stderr = proc.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                import signal
+
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait()
+                raise
+            for line in stdout.splitlines():
+                if line.startswith("PIPELINE="):
+                    result["pipeline"] = json.loads(
+                        line[len("PIPELINE="):])
+                    break
+            else:
+                result["pipeline_error"] = (stderr or "no output")[-500:]
+        except Exception as e:
+            result["pipeline_error"] = repr(e)
+
     # Lint gate wall-clock (ISSUE 5): `ray_tpu lint` runs as a tier-1 test
     # on every PR; record its full-tree cost so the gate visibly stays
     # inside its < 10 s CPU budget instead of quietly becoming the slow

@@ -24,6 +24,21 @@ Two sub-rules:
 - ``collective-timeout.call`` — a call through the collective API (module
   alias or ``from ... import recv``) to an op we cannot see a
   timeout-defaulted def for, without an explicit ``timeout_s=``.
+
+The same hang physics applies to MPMD pipeline stages (``train/pipeline/``):
+a dead adjacent stage parks its peer in a channel ``recv`` forever unless
+the wait is bounded and probed (``PipelineStageDied`` needs a bounded loop
+to fire from).  Inside ``train/pipeline/`` the checker therefore also
+enforces:
+
+- pipeline ``.def``: every public def whose name denotes a stage wait
+  (``send``/``recv``/``*_wait*``/``connect_*``) must accept ``timeout_s``;
+  ``_``-private helpers inherit their caller's deadline and are exempt.
+- pipeline ``.call``: a ``send``/``recv`` call with no ``timeout_s=`` whose
+  target we cannot see a timeout-defaulted pipeline def for, and any raw
+  channel-primitive ``.read(...)``/``.write(...)`` on a channel-ish receiver
+  (``ch``/``chan``/``*_ch``/``*channel*``/``link``) without ``timeout=`` —
+  the unbounded form of the SPSC ring wait.
 """
 
 from __future__ import annotations
@@ -36,6 +51,11 @@ from ray_tpu._lint.core import Checker, FileCtx, Finding, register
 COLLECTIVE_OPS = {"allreduce", "allgather", "reducescatter", "broadcast",
                   "barrier", "send", "recv"}
 _COLLECTIVE_MODULE = "ray_tpu.util.collective"
+
+# stage-wait tokens inside train/pipeline/: link frame ops, rendezvous
+# waits, channel connection — everything that can park a stage on a peer
+PIPELINE_WAIT_OPS = {"send", "recv", "wait", "connect"}
+_CHANNEL_PRIMITIVES = {"read", "write"}
 
 
 def _entry_point_op(name: str):
@@ -54,6 +74,35 @@ def _entry_point_op(name: str):
         if part in COLLECTIVE_OPS:
             return part
     return None
+
+
+def _pipeline_wait_op(name: str):
+    """The stage-wait op a pipeline def/call name denotes, or None.
+    ``_``-private helpers inherit their caller's deadline and are exempt."""
+    if name.startswith("_"):
+        return None
+    if name in PIPELINE_WAIT_OPS:
+        return name
+    for part in name.split("_"):
+        if part in PIPELINE_WAIT_OPS:
+            return part
+    return None
+
+
+def _channelish_receiver(base) -> bool:
+    """True when an attribute call's receiver looks like a channel handle
+    (heuristic by name: ``ch``, ``chan``, ``self._ch``, ``*channel*``,
+    ``link``) — the receivers whose ``.read``/``.write`` are SPSC ring
+    waits, not file I/O."""
+    name = None
+    if isinstance(base, ast.Name):
+        name = base.id
+    elif isinstance(base, ast.Attribute):
+        name = base.attr
+    if name is None:
+        return False
+    n = name.lstrip("_").lower()
+    return n in ("ch", "chan", "link") or "chan" in n or n.endswith("_ch")
 
 
 def _collective_aliases(tree: ast.AST) -> tuple:
@@ -135,6 +184,54 @@ class CollectiveTimeoutChecker(Checker):
                     f"resolved op has no bounded default — pass timeout_s= "
                     f"so a straggler raises CollectiveTimeout instead of "
                     f"hanging"))
+        # pass 3: MPMD stage waits inside train/pipeline/ — a dead adjacent
+        # stage parks its peer forever unless every channel wait is bounded
+        # (the probe loop PipelineStageDied fires from needs a deadline)
+        pipeline_files = [ctx for ctx in files
+                          if "train/pipeline/" in ctx.relpath]
+        pipeline_defaulted: Set[str] = set()
+        for ctx in pipeline_files:
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and _pipeline_wait_op(node.name) is not None:
+                    if _has_timeout_param(node):
+                        pipeline_defaulted.add(node.name)
+                    else:
+                        out.append(ctx.finding(
+                            "collective-timeout.def", node,
+                            f"pipeline stage wait `{node.name}` takes no "
+                            f"`timeout_s` — a dead adjacent stage hangs "
+                            f"this stage forever; accept timeout_s so the "
+                            f"bounded probe loop can raise "
+                            f"PipelineStageDied/CollectiveTimeout"))
+        for ctx in pipeline_files:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call) \
+                        or not isinstance(node.func, ast.Attribute):
+                    continue
+                attr = node.func.attr
+                if attr in _CHANNEL_PRIMITIVES:
+                    if _channelish_receiver(node.func.value) and not any(
+                            kw.arg == "timeout" for kw in node.keywords):
+                        out.append(ctx.finding(
+                            "collective-timeout.call", node,
+                            f"raw channel `.{attr}(...)` in pipeline code "
+                            f"without `timeout=` — the unbounded SPSC ring "
+                            f"wait; slice the deadline into probe intervals "
+                            f"(StageLink) or pass timeout="))
+                    continue
+                op = _pipeline_wait_op(attr)
+                if op is None or op in ("wait", "connect"):
+                    continue  # wait/connect are def-side obligations only
+                if any(kw.arg == "timeout_s" for kw in node.keywords):
+                    continue
+                if attr in pipeline_defaulted or attr in defaulted_defs:
+                    continue  # the def carries a bounded default
+                out.append(ctx.finding(
+                    "collective-timeout.call", node,
+                    f"pipeline `{attr}` called without `timeout_s` and no "
+                    f"timeout-defaulted def in sight — a dead stage would "
+                    f"hang this wait forever"))
         return out
 
     @staticmethod

@@ -75,6 +75,12 @@ POINTS: Dict[str, dict] = {
         "detail": "experiment name",
         "actions": ("kill",),
     },
+    "pipeline.stage_step": {
+        "where": "train.pipeline.schedule.StageExecutor, before each 1F1B "
+                 "schedule op runs (fwd/bwd/send/recv/optim)",
+        "detail": "'stage<S>:<op><microbatch>' of this stage's next op",
+        "actions": ("kill",),
+    },
     "collective.step": {
         "where": "collective ring reduce-scatter, after this rank's first "
                  "chunk is on the wire (peers are already waiting on us)",

@@ -1,0 +1,193 @@
+"""Pipeline-parallel throughput A/B (ISSUE 10).
+
+Tiny-GPT-2 tokens/sec, 1-stage baseline vs a 2-stage 1F1B pipeline at
+M ∈ {1, 4, 8} microbatches, on the in-process thread-gang harness (two
+``StageExecutor``s wired over raw ShmChannels — the same transport the
+actor path uses, minus the actor hop).  Variants are interleaved A/B
+within each round and the per-variant number is the min over rounds, so
+box noise hits both sides of every ratio equally.
+
+Next to the raw wall-clock numbers the row reports the bubble two ways:
+
+- ``bubble_fraction_measured`` — wall-clock based, from the executors'
+  BubbleClock (time blocked on a peer / step wall).  On a box with
+  >= 2 cores this is the real pipeline bubble.
+- ``bubble_fraction_overlap`` — overlap-accounted: both stages' measured
+  *busy* seconds replayed onto the 1F1B critical path
+  ``max_busy * (M + S - 1) / M`` that concurrent stages would follow.
+  On a 1-core box the stages time-slice one core, so raw wall clock
+  cannot show pipelining gains; the overlap account is the
+  platform-independent number and converges to the theoretical
+  ``(S - 1) / (S - 1 + M)`` as M grows.
+
+``projected_speedup_overlap`` is the companion throughput claim:
+``sum(busy) / (max_busy * (M + S - 1) / M)`` — what the 2-stage run
+delivers over the serial single gang once each stage owns a core.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List
+
+import numpy as np
+
+STAGES = 2
+MICROS = (1, 4, 8)
+ROUNDS = 3
+STEPS_PER_ROUND = 4
+BATCH, SEQ = 8, 32
+
+
+def _tiny_cfg():
+    import jax.numpy as jnp
+
+    from ray_tpu.models.gpt2 import GPT2Config
+
+    # 4 layers so the 2/2 stage split is near-balanced: with a 2-layer
+    # trunk the LM-head stage dominates and stage imbalance (not the 1F1B
+    # schedule) would own the bubble number
+    return GPT2Config(vocab_size=128, n_positions=32, n_embd=32, n_layer=4,
+                      n_head=4, dtype=jnp.float32)
+
+
+def _batch(cfg, step: int):
+    rng = np.random.default_rng(1000 + step)
+    return {
+        "input_ids": rng.integers(0, cfg.vocab_size, (BATCH, SEQ),
+                                  dtype=np.int32),
+        "targets": rng.integers(0, cfg.vocab_size, (BATCH, SEQ),
+                                dtype=np.int32),
+    }
+
+
+def _direct_links(timeout_s=120.0, depth=12):
+    from ray_tpu.experimental.channel import ShmChannel
+    from ray_tpu.train.pipeline import StageLink
+
+    act = ShmChannel(create=True, slot_size=1 << 20, depth=depth)
+    grad = ShmChannel(create=True, slot_size=1 << 20, depth=depth)
+    links0 = {
+        "act_out": StageLink(act, peer_stage=1, role="w",
+                             timeout_s=timeout_s),
+        "grad_in": StageLink(ShmChannel(grad.name), peer_stage=1, role="r",
+                             timeout_s=timeout_s),
+    }
+    links1 = {
+        "act_in": StageLink(ShmChannel(act.name), peer_stage=0, role="r",
+                            timeout_s=timeout_s),
+        "grad_out": StageLink(grad, peer_stage=0, role="w",
+                              timeout_s=timeout_s),
+    }
+    return links0, links1
+
+
+def _run_steps_single(ex, cfg, start: int, n: int) -> List[Dict]:
+    return [ex.train_step(_batch(cfg, start + s)) for s in range(n)]
+
+
+def _run_steps_pipeline(ex0, ex1, cfg, start: int, n: int):
+    import threading
+
+    outs0: List[Dict] = []
+    outs1: List[Dict] = []
+    errs: List[BaseException] = []
+
+    def _stage1():
+        try:
+            for s in range(n):
+                outs1.append(ex1.train_step(_batch(cfg, start + s)))
+        except BaseException as e:  # re-raised on the driving thread
+            errs.append(e)
+
+    t = threading.Thread(target=_stage1)
+    t.start()
+    try:
+        for s in range(n):
+            outs0.append(ex0.train_step(_batch(cfg, start + s)))
+    finally:
+        t.join(300)
+    if errs:
+        raise errs[0]
+    return outs0, outs1
+
+
+def run_pipeline_bench() -> dict:
+    import jax
+
+    from ray_tpu.train.pipeline import (
+        GPT2StageModule, StageExecutor, pipeline_mesh,
+        theoretical_bubble_fraction)
+
+    cfg = _tiny_cfg()
+    # one device per gang: this measures the SCHEDULE, not GSPMD; virtual
+    # multi-device partitioning would only add per-op dispatch overhead
+    mesh = pipeline_mesh(devices=jax.devices()[:1])
+    tokens_per_step = BATCH * SEQ
+
+    out: dict = {
+        "stages": STAGES, "micros": list(MICROS), "rounds": ROUNDS,
+        "steps_per_round": STEPS_PER_ROUND, "batch": BATCH, "seq": SEQ,
+        "host_cpus": os.cpu_count(), "variants": [],
+    }
+
+    for m in MICROS:
+        ex1 = StageExecutor(GPT2StageModule(cfg, 0, 1), mesh, n_micro=m,
+                            lr=1e-3, total_steps=1000)
+        links0, links1 = _direct_links()
+        ex_a = StageExecutor(GPT2StageModule(cfg, 0, STAGES), mesh,
+                             n_micro=m, links=links0, lr=1e-3,
+                             total_steps=1000)
+        ex_b = StageExecutor(GPT2StageModule(cfg, 1, STAGES), mesh,
+                             n_micro=m, links=links1, lr=1e-3,
+                             total_steps=1000)
+        # compile warmup (outside every timed window)
+        _run_steps_single(ex1, cfg, 0, 1)
+        _run_steps_pipeline(ex_a, ex_b, cfg, 0, 1)
+
+        best_s1 = best_s2 = float("inf")
+        best_outs: tuple = ()
+        step = 1
+        for _ in range(ROUNDS):
+            # interleaved A/B: baseline then pipeline inside the same round
+            t0 = time.perf_counter()
+            _run_steps_single(ex1, cfg, step, STEPS_PER_ROUND)
+            best_s1 = min(best_s1, time.perf_counter() - t0)
+
+            t0 = time.perf_counter()
+            o0, o1 = _run_steps_pipeline(ex_a, ex_b, cfg, step,
+                                         STEPS_PER_ROUND)
+            dt = time.perf_counter() - t0
+            if dt < best_s2:
+                # clock splits from the min round only: post-compile cgroup
+                # throttling makes early rounds unrepresentative, same
+                # reason the throughput number is min-of-rounds
+                best_s2, best_outs = dt, (o0, o1)
+            step += STEPS_PER_ROUND
+
+        busy = [sum(o["busy_s"] for o in outs) for outs in best_outs]
+        wall = sum(o["step_wall_s"] for o in best_outs[0] + best_outs[1])
+        bubble = sum(o["bubble_s"] for o in best_outs[0] + best_outs[1])
+        # overlap accounting: measured per-stage busy time replayed onto
+        # the 1F1B critical path max_busy*(M+S-1)/M concurrent stages
+        # would follow (what a >= S-core box's wall clock shows directly)
+        crit = max(busy) * (m + STAGES - 1) / m
+        s1_tps = tokens_per_step * STEPS_PER_ROUND / best_s1
+        s2_tps = tokens_per_step * STEPS_PER_ROUND / best_s2
+        out["variants"].append({
+            "n_micro": m,
+            "s1_tokens_per_sec": round(s1_tps, 1),
+            "s2_tokens_per_sec": round(s2_tps, 1),
+            "measured_speedup": round(s2_tps / s1_tps, 3),
+            "bubble_fraction_measured": round(bubble / wall, 4),
+            "bubble_fraction_overlap": round(
+                1.0 - sum(busy) / (STAGES * crit), 4),
+            "bubble_fraction_theoretical": round(
+                theoretical_bubble_fraction(STAGES, m), 4),
+            "projected_speedup_overlap": round(sum(busy) / crit, 3),
+            "stage_busy_s": [round(b, 4) for b in busy],
+        })
+        ex_a.close()
+        ex_b.close()
+    return out

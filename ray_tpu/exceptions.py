@@ -181,3 +181,20 @@ class CollectiveWorkerDied(CollectiveError):
         self.group = group
         self.op = op
         self.rank = rank
+
+
+class PipelineStageDied(CollectiveError):
+    """A pipeline-parallel stage's gang died mid-schedule.  The blocked
+    neighbour's channel wait detects it via the stage liveness probe (stale
+    endpoint stamp + dead pid / refused socket) the same way
+    ``CollectiveWorkerDied`` does for collective ranks — the caller learns
+    WHICH stage is gone in seconds instead of burning the full op timeout.
+    Recover by restarting the job from the last per-stage checkpoint
+    (``FailureConfig(max_failures=...)`` on the trainer) or fail cleanly."""
+
+    def __init__(self, message: str, stage: int = -1, op: str = "",
+                 rank: int = -1):
+        super().__init__(message)
+        self.stage = stage
+        self.op = op
+        self.rank = rank

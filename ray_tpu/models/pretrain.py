@@ -158,7 +158,9 @@ class ShardedPretrainer:
         ckptr.close()
 
     def shard_batch(self, batch: Dict[str, Any]):
-        return {k: jax.device_put(jnp.asarray(v), self.batch_sharding[k])
+        from ray_tpu.parallel.sharding import host_to_global
+
+        return {k: host_to_global(jnp.asarray(v), self.batch_sharding[k])
                 for k, v in batch.items() if k in self.batch_sharding}
 
     def step(self, batch: Dict[str, Any]):

@@ -92,22 +92,48 @@ def _flatten_with_paths(tree):
     return out, treedef
 
 
-def match_partition_rules(rules: PartitionRules, params):
-    """Pytree of params → pytree of PartitionSpec."""
+def match_partition_rules(rules, params):
+    """Pytree of params → pytree of PartitionSpec.  ``rules`` is a
+    PartitionRules or a raw ``[(regex, PartitionSpec), ...]`` sequence."""
     import jax
 
+    if not isinstance(rules, PartitionRules):
+        rules = PartitionRules(rules)
     flat, treedef = _flatten_with_paths(params)
     specs = [rules.spec_for(name, getattr(leaf, "shape", ())) for name, leaf in flat]
     return jax.tree_util.tree_unflatten(treedef, specs)
 
 
+def host_to_global(x, sharding):
+    """Host value -> global jax.Array under ``sharding``.
+
+    Single-process meshes take the plain ``device_put`` path.  When the
+    sharding spans processes, ``device_put`` of a host value is not a
+    supported multi-controller transfer (on the CPU/gloo backend it issues
+    mismatched point-to-point ops that abort the whole gang); the supported
+    construction is per-process assembly from addressable shards.  Every
+    caller here holds the SAME full host value on every process (seeded init,
+    seeded batches), so each process can slice its own shards locally and no
+    bytes cross the wire.
+    """
+    import jax
+
+    if all(d.process_index == jax.process_index()
+           for d in sharding.device_set):
+        return jax.device_put(x, sharding)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx])
+
+
 def shard_pytree(params, specs, mesh):
-    """Device-put a pytree with NamedShardings built from specs."""
+    """Device-put a pytree with NamedShardings built from specs (multi-
+    process safe: see host_to_global)."""
     import jax
     from jax.sharding import NamedSharding
 
     return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
+        lambda x, s: host_to_global(x, NamedSharding(mesh, s)), params, specs)
 
 
 def with_sharding_constraint(x, spec, mesh=None):

@@ -24,11 +24,13 @@ from ray_tpu.train.base_trainer import BaseTrainer, DataParallelTrainer
 from ray_tpu.train.jax_config import BackendConfig, JaxConfig
 from ray_tpu.train.jax_trainer import JaxTrainer
 from ray_tpu.train._backend_executor import TrainingFailedError
+from ray_tpu.train import pipeline
 
 __all__ = [
     "BaseTrainer", "DataParallelTrainer", "JaxTrainer",
     "BackendConfig", "JaxConfig",
     "Checkpoint", "TrainContext", "TrainingFailedError",
+    "pipeline",
     "report", "get_checkpoint", "get_context", "get_dataset_shard",
     "ScalingConfig", "RunConfig", "FailureConfig", "CheckpointConfig",
     "Result",

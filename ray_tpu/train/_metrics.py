@@ -64,5 +64,19 @@ def train_metrics() -> Dict[str, M.Metric]:
                         "train_checkpoint_restore_seconds",
                         "checkpoint download/materialize duration",
                         boundaries=CHECKPOINT_SECONDS_BOUNDARIES),
+                    "pipeline_bubble": M.Counter(
+                        "pipeline_bubble_seconds",
+                        "seconds a pipeline stage spent blocked on "
+                        "inter-stage recv (schedule bubble), per experiment "
+                        "and stage"),
+                    "pipeline_bubble_fraction": M.Gauge(
+                        "pipeline_bubble_fraction",
+                        "recv-blocked fraction of the last step's wall "
+                        "clock on this stage, per experiment and stage"),
+                    "pipeline_stage_busy": M.Gauge(
+                        "pipeline_stage_busy_seconds",
+                        "compute (fwd+bwd+optim) seconds of the last step "
+                        "on this stage — the overlap-accounting numerator, "
+                        "per experiment and stage"),
                 }
     return _metrics

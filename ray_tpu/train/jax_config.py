@@ -66,16 +66,25 @@ class JaxConfig(BackendConfig):
     platform: Optional[str] = None
     cpu_devices_per_worker: int = 1
     coordinator_port: Optional[int] = None
+    # MPMD pipeline layout (set by JaxTrainer(pipeline_stages=N)): split the
+    # worker group into N contiguous stage gangs, each its own jax world —
+    # stages exchange channel frames, never XLA collectives, so a gang of 1
+    # skips jax.distributed entirely (local devices only).
+    pipeline_stages: int = 1
 
     @property
     def backend_cls(self):
         return _JaxBackend
 
 
-def _setup_jax_distributed(coordinator: str, num_processes: int,
+def _setup_jax_distributed(coordinator: Optional[str], num_processes: int,
                            process_id: int, platform: Optional[str],
                            cpu_devices_per_worker: int) -> dict:
-    """Runs INSIDE each train worker before any jax device use."""
+    """Runs INSIDE each train worker before any jax device use.
+
+    ``coordinator=None`` is the single-process-gang path (pipeline stage
+    gangs of one worker): same platform/device bring-up, no
+    jax.distributed service."""
     import os
 
     if platform is None:
@@ -98,12 +107,16 @@ def _setup_jax_distributed(coordinator: str, num_processes: int,
         # The TPU-VM site hook re-pins jax.config.jax_platforms after import;
         # defeat it the same way _private/platform.py does.
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        if coordinator is not None:
+            # gloo needs the jax.distributed client; a one-process gang has
+            # none (local XLA collectives only)
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
     else:
         import jax
 
-    jax.distributed.initialize(coordinator, num_processes=num_processes,
-                               process_id=process_id)
+    if coordinator is not None:
+        jax.distributed.initialize(coordinator, num_processes=num_processes,
+                                   process_id=process_id)
     return {
         "process_id": jax.process_index(),
         "process_count": jax.process_count(),
@@ -126,21 +139,38 @@ class _JaxBackend(Backend):
     def on_start(self, worker_group: WorkerGroup, backend_config: JaxConfig):
         import ray_tpu
 
-        port = backend_config.coordinator_port or worker_group.execute_single(
-            0, _free_port)
-        coordinator = f"{worker_group.metadata[0].node_ip}:{port}"
         n = len(worker_group)
-        refs = [
-            w.execute.remote(_setup_jax_distributed, coordinator, n, rank,
-                             backend_config.platform,
-                             backend_config.cpu_devices_per_worker)
-            for rank, w in enumerate(worker_group.workers)
-        ]
-        infos = ray_tpu.get(refs, timeout=120.0)
-        counts = {i["global_device_count"] for i in infos}
-        if len(counts) != 1:
+        stages = max(1, backend_config.pipeline_stages)
+        if n % stages:
             raise RuntimeError(
-                f"jax.distributed came up inconsistent across the gang: {infos}")
+                f"worker group of {n} not divisible by pipeline_stages "
+                f"{stages}")
+        gang = n // stages
+        refs = []
+        for s in range(stages):
+            lo = s * gang
+            if gang == 1:
+                coordinator = None  # one-process gang: no jax.distributed
+            else:
+                port = backend_config.coordinator_port or \
+                    worker_group.execute_single(lo, _free_port)
+                coordinator = f"{worker_group.metadata[lo].node_ip}:{port}"
+            for gr in range(gang):
+                w = worker_group.workers[lo + gr]
+                refs.append(w.execute.remote(
+                    _setup_jax_distributed, coordinator, gang, gr,
+                    backend_config.platform,
+                    backend_config.cpu_devices_per_worker))
+        infos = ray_tpu.get(refs, timeout=120.0)
+        # device counts must agree WITHIN each stage gang (gangs are
+        # independent jax worlds and may differ across stages)
+        for s in range(stages):
+            counts = {i["global_device_count"]
+                      for i in infos[s * gang:(s + 1) * gang]}
+            if len(counts) != 1:
+                raise RuntimeError(
+                    f"jax.distributed came up inconsistent across stage "
+                    f"{s}'s gang: {infos[s * gang:(s + 1) * gang]}")
         self.device_info = infos[0]
 
     def on_shutdown(self, worker_group: WorkerGroup,

@@ -38,6 +38,14 @@ from ray_tpu.train.jax_config import JaxConfig
 
 
 class JaxTrainer(DataParallelTrainer):
+    """``pipeline_stages=N`` switches the worker layout from one SPMD gang
+    to N MPMD stage gangs (``ray_tpu.train.pipeline``): workers split into
+    N contiguous gangs, each gang brings up its OWN jax world (no
+    cross-stage jax.distributed — stages talk through channel frames, not
+    XLA collectives), and the train loop sees ``_pipeline`` =
+    ``{"n_stages": N, "n_micro": M}`` in its config.  ``num_microbatches``
+    is the gradient-accumulation width of the 1F1B schedule."""
+
     _default_backend_config = JaxConfig()
 
     def __init__(self, train_loop_per_worker: Callable,
@@ -46,11 +54,36 @@ class JaxTrainer(DataParallelTrainer):
                  scaling_config: Optional[ScalingConfig] = None,
                  run_config: Optional[RunConfig] = None,
                  datasets: Optional[Dict[str, Any]] = None,
-                 resume_from_checkpoint: Optional[Checkpoint] = None):
+                 resume_from_checkpoint: Optional[Checkpoint] = None,
+                 pipeline_stages: int = 1,
+                 num_microbatches: int = 1):
+        import dataclasses
+
+        if pipeline_stages < 1:
+            raise ValueError(f"pipeline_stages must be >= 1, got "
+                             f"{pipeline_stages}")
+        if num_microbatches < 1:
+            raise ValueError(f"num_microbatches must be >= 1, got "
+                             f"{num_microbatches}")
+        jax_config = jax_config or JaxConfig()
+        if pipeline_stages > 1:
+            num_workers = (scaling_config or ScalingConfig()).num_workers
+            if num_workers % pipeline_stages:
+                raise ValueError(
+                    f"num_workers {num_workers} not divisible by "
+                    f"pipeline_stages {pipeline_stages}")
+            jax_config = dataclasses.replace(
+                jax_config, pipeline_stages=pipeline_stages)
+        if pipeline_stages > 1 or num_microbatches > 1:
+            train_loop_config = dict(train_loop_config or {})
+            train_loop_config["_pipeline"] = {
+                "n_stages": pipeline_stages, "n_micro": num_microbatches}
+        self.pipeline_stages = pipeline_stages
+        self.num_microbatches = num_microbatches
         super().__init__(
             train_loop_per_worker,
             train_loop_config=train_loop_config,
-            backend_config=jax_config or JaxConfig(),
+            backend_config=jax_config,
             scaling_config=scaling_config,
             run_config=run_config,
             datasets=datasets,

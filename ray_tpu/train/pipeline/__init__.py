@@ -1,0 +1,52 @@
+"""ray_tpu.train.pipeline: MPMD pipeline-parallel training over actor gangs.
+
+The new layer ROADMAP item 2 calls for, after the blueprint of "Scaling
+Deep Learning Training with MPMD Pipeline Parallelism" (arXiv:2412.14374):
+the model's layer stack splits into N contiguous stages (`partition`), each
+stage runs as its own gang with the stage GSPMD-sharded over the gang's
+mesh, adjacent stages exchange activations/gradients over compiled-DAG
+channel primitives (`channels`), and a deterministic 1F1B schedule drives
+each stage's train session (`schedule`).  ``loop.gpt2_pipeline_loop`` is
+the ready-made train loop ``JaxTrainer(pipeline_stages=N,
+num_microbatches=M)`` runs per worker.
+"""
+
+from ray_tpu.exceptions import PipelineStageDied
+from ray_tpu.train.pipeline.channels import (
+    StageLink,
+    connect_links,
+    publish_endpoint,
+    stage_alive,
+    stamp_progress,
+)
+from ray_tpu.train.pipeline.loop import gpt2_pipeline_loop
+from ray_tpu.train.pipeline.partition import (
+    GPT2StageModule,
+    PartitionRules,
+    load_pipeline_checkpoint,
+    make_shard_and_gather_fns,
+    match_partition_rules,
+    pipeline_mesh,
+    save_stage_shard,
+    stage_ranges,
+)
+from ray_tpu.train.pipeline.schedule import (
+    BubbleClock,
+    PipelineOp,
+    StageExecutor,
+    make_pipeline_optimizer,
+    one_f_one_b,
+    theoretical_bubble_fraction,
+)
+
+__all__ = [
+    "PipelineStageDied",
+    "StageLink", "connect_links", "publish_endpoint", "stage_alive",
+    "stamp_progress",
+    "gpt2_pipeline_loop",
+    "GPT2StageModule", "PartitionRules", "load_pipeline_checkpoint",
+    "make_shard_and_gather_fns", "match_partition_rules", "pipeline_mesh",
+    "save_stage_shard", "stage_ranges",
+    "BubbleClock", "PipelineOp", "StageExecutor", "make_pipeline_optimizer",
+    "one_f_one_b", "theoretical_bubble_fraction",
+]

@@ -1,0 +1,129 @@
+"""The ready-made ``train_loop_per_worker`` for pipeline-parallel GPT-2.
+
+``JaxTrainer(gpt2_pipeline_loop, pipeline_stages=N, num_microbatches=M,
+scaling_config=ScalingConfig(num_workers=N))`` gives each worker one stage:
+the worker derives its stage id from its world rank, builds its stage module
+and gang-local mesh, rendezvouses its channels over the GCS KV, and drives
+the 1F1B executor — reporting loss/grad-norm (reduced to stage 0 by the
+schedule's commit frame) and the bubble accounting through the normal
+``train.report`` lockstep, so heartbeats, gang-skew and checkpoint retention
+all behave exactly as they do for SPMD jobs.
+
+``train_loop_config`` keys: ``steps``, ``batch_size``, ``seq_len``,
+``model`` (GPT2Config field overrides, applied over ``GPT2Config.tiny()``),
+``lr``, ``seed``, ``timeout_s``, ``checkpoint_every`` (0 = only the final
+step checkpoints).  The driver injects ``_pipeline`` = {n_stages, n_micro}.
+
+Checkpoint layout: every stage leader writes its gathered slice as
+``pipe_stage.npz`` keyed by CANONICAL layer names; the trainer's persist
+step files stage 0's under the checkpoint dir and the rest under
+``rank_<k>/``.  Restore merges every shard and re-selects this job's
+slices, so an N-stage checkpoint restores onto any other stage count
+bit-exact after gather.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+from typing import Any, Dict
+
+import numpy as np
+
+
+def gpt2_pipeline_loop(config: Dict[str, Any]) -> None:
+    from ray_tpu import train
+    from ray_tpu.models.gpt2 import GPT2Config
+    from ray_tpu.train.pipeline import channels as pipechan
+    from ray_tpu.train.pipeline.partition import (
+        GPT2StageModule, load_pipeline_checkpoint, pipeline_mesh,
+        save_stage_shard)
+    from ray_tpu.train.pipeline.schedule import StageExecutor
+
+    ctx = train.get_context()
+    pcfg = config.get("_pipeline") or {"n_stages": 1, "n_micro": 1}
+    n_stages, n_micro = int(pcfg["n_stages"]), int(pcfg["n_micro"])
+    world = ctx.get_world_size()
+    if world % n_stages:
+        raise ValueError(
+            f"num_workers {world} not divisible by pipeline_stages {n_stages}")
+    gang_size = world // n_stages
+    if gang_size != 1 and n_stages > 1:
+        raise NotImplementedError(
+            "multi-process stage gangs are not composed yet: use "
+            "num_workers == pipeline_stages (each stage still shards over "
+            "its worker's local devices)")
+    stage = ctx.get_world_rank() // gang_size
+    job = config.get("job") or ctx.get_experiment_name()
+
+    model_cfg = GPT2Config.tiny()
+    overrides = dict(config.get("model") or {})
+    if "dtype" in overrides and isinstance(overrides["dtype"], str):
+        import jax.numpy as jnp
+
+        overrides["dtype"] = getattr(jnp, overrides["dtype"])
+    if overrides:
+        model_cfg = dataclasses.replace(model_cfg, **overrides)
+
+    steps = int(config.get("steps", 4))
+    batch_size = int(config.get("batch_size", 8))
+    seq_len = int(config.get("seq_len", min(32, model_cfg.n_positions)))
+    ckpt_every = int(config.get("checkpoint_every", 0))
+    timeout_s = float(config.get("timeout_s", 60.0))
+
+    module = GPT2StageModule(model_cfg, stage, n_stages)
+    mesh = pipeline_mesh()
+    links = pipechan.connect_links(job, stage, n_stages, n_micro,
+                                   timeout_s=timeout_s) if n_stages > 1 else {}
+    executor = StageExecutor(
+        module, mesh, n_micro=n_micro, links=links,
+        lr=float(config.get("lr", 3e-4)), total_steps=max(steps, 101),
+        timeout_s=timeout_s, job=job, experiment=ctx.get_experiment_name(),
+        seed=int(config.get("seed", 0)))
+
+    start_step = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        with ckpt.as_directory() as d:
+            full, saved_step = load_pipeline_checkpoint(d)
+            executor.load_full_params(full)
+            start_step = saved_step + 1
+
+    def _checkpoint(step: int):
+        d = tempfile.mkdtemp()
+        save_stage_shard(
+            os.path.join(d, "pipe_stage.npz"), executor.params,
+            stage=stage, n_stages=n_stages, step=step,
+            gather_fns=executor.gather_fns)
+        return train.Checkpoint.from_directory(d)
+
+    rng_seed = int(config.get("seed", 0))
+    if start_step >= steps:
+        # restored at or past the horizon: re-emit the restored params so a
+        # cross-stage-count restore is observable without training further
+        train.report({"step": start_step - 1, "stage": stage,
+                      "restored": True}, checkpoint=_checkpoint(start_step - 1))
+        return
+
+    for step in range(start_step, steps):
+        # every stage derives the SAME global batch from the seeded stream
+        # (stage 0 reads input_ids, the last stage reads targets)
+        rng = np.random.default_rng((rng_seed << 20) + step)
+        batch = {
+            "input_ids": rng.integers(
+                0, model_cfg.vocab_size, (batch_size, seq_len),
+                dtype=np.int32),
+            "targets": rng.integers(
+                0, model_cfg.vocab_size, (batch_size, seq_len),
+                dtype=np.int32),
+        }
+        out = executor.train_step(batch)
+        checkpoint = None
+        if step == steps - 1 or (ckpt_every and (step + 1) % ckpt_every == 0):
+            checkpoint = _checkpoint(step)
+        train.report({k: out[k] for k in
+                      ("loss", "grad_norm", "step", "stage", "step_wall_s",
+                       "busy_s", "xfer_s", "bubble_s", "bubble_fraction")},
+                     checkpoint=checkpoint)
+    executor.close()

@@ -547,3 +547,63 @@ def test_unknown_checker_rejected():
     with pytest.raises(ValueError, match="unknown checker"):
         run_lint(files=[FileCtx("m.py", "x = 1\n")],
                  checkers=["no-such-rule"], baseline=None)
+
+
+# ============================== collective-timeout: pipeline stage waits
+
+def test_collective_timeout_pipeline_defs():
+    """Public stage-wait defs in train/pipeline/ must accept timeout_s;
+    private helpers inherit their caller's deadline and are exempt."""
+    mixed = FileCtx("ray_tpu/train/pipeline/channels.py", '''
+def recv(tag):                                 # BAD: unbounded stage wait
+    pass
+def wait_endpoint(job, stage):                 # BAD: unbounded rendezvous
+    pass
+def send(tag, payload, timeout_s=None):        # bounded default: fine
+    pass
+def connect_links(job, stage, timeout_s=60.0): # bounded default: fine
+    pass
+def _wait_kv(key, deadline):                   # private helper: exempt
+    pass
+def stage_ranges(n, s):                        # not a wait: fine
+    pass
+''')
+    result = run_lint(files=[mixed], checkers=["collective-timeout"],
+                      baseline=None)
+    assert rules_of(result.findings) == ["collective-timeout.def"] * 2
+    assert "`recv`" in result.findings[0].message
+    assert "PipelineStageDied" in result.findings[0].message
+    assert "`wait_endpoint`" in result.findings[1].message
+
+
+def test_collective_timeout_pipeline_calls_and_raw_channel_waits():
+    """Un-timed .recv()/.send() frame ops and raw channel .read()/.write()
+    in pipeline code are flagged; timed ones and non-channel receivers
+    are not."""
+    caller = FileCtx("ray_tpu/train/pipeline/schedule.py", '''
+def recv(tag, timeout_s=None):                 # bounded def in scope
+    pass
+link.recv("0.a0")                              # fine: def above is bounded
+link.send("0.g0", payload, timeout_s=5.0)      # explicit: fine
+ch.read()                                      # BAD: unbounded ring wait
+self._ch.write(frame)                          # BAD: unbounded ring wait
+chan.read(timeout=0.25)                        # bounded primitive: fine
+f.write(data)                                  # file handle: not a channel
+''')
+    result = run_lint(files=[caller], checkers=["collective-timeout"],
+                      baseline=None)
+    assert rules_of(result.findings) == ["collective-timeout.call"] * 2
+    assert ".read" in result.findings[0].message
+    assert ".write" in result.findings[1].message
+
+
+def test_collective_timeout_pipeline_unresolved_recv_flagged():
+    """A pipeline .recv() with no timeout_s and no bounded def in sight
+    can hang on a dead stage — flagged."""
+    caller = FileCtx("ray_tpu/train/pipeline/loop.py", '''
+links["act_in"].recv("0.a0")
+''')
+    result = run_lint(files=[caller], checkers=["collective-timeout"],
+                      baseline=None)
+    assert rules_of(result.findings) == ["collective-timeout.call"]
+    assert "`recv`" in result.findings[0].message
