@@ -290,6 +290,17 @@ def main() -> None:
         # report a number nobody should believe.
         result["timing_note"] = "mfu>1.0: timing suspect despite fetch sync"
 
+    # Bench rig (ISSUE 12): pin bench workers to dedicated cores where the
+    # box allows it and stamp every row with the topology it measured on.
+    # RAY_TPU_BENCH_RIG=0 skips pinning; rows then carry pinned=false.
+    from ray_tpu._private import bench_rig
+
+    rig = bench_rig.metadata()
+    result["rig"] = rig
+    # pool exported to the subprocess benches below: their runtime workers
+    # pin themselves in worker_main (empty dict on 1-core / rig-off)
+    rig_env = bench_rig.pin_env(max(rig["num_cpus"], 2))
+
     # Core-runtime microbenchmarks (reference: ray_perf.py / BASELINE.md),
     # in a subprocess so runtime processes can't disturb the TPU number and
     # a runtime bug can't take down the headline line.
@@ -309,6 +320,7 @@ def main() -> None:
                 "print('MICRO=' + json.dumps(out))")
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
+        env.update(rig_env)
         try:
             # own process group: on timeout the WHOLE runtime tree (gcs,
             # nodelet, workers + their shm store) must die, not just the
@@ -419,6 +431,7 @@ def main() -> None:
                 "print('COLLECTIVE=' + json.dumps(run_collective_bench()))")
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
+        env.update(rig_env)
         try:
             proc = subprocess.Popen([sys.executable, "-c", code],
                                     stdout=subprocess.PIPE,
@@ -458,6 +471,7 @@ def main() -> None:
                 "print('RECOVERY=' + json.dumps(run_recovery_bench()))")
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
+        env.update(rig_env)
         try:
             proc = subprocess.Popen([sys.executable, "-c", code],
                                     stdout=subprocess.PIPE,
@@ -496,6 +510,7 @@ def main() -> None:
                 "print('PIPELINE=' + json.dumps(run_pipeline_bench()))")
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
+        env.update(rig_env)
         env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
         try:
             proc = subprocess.Popen([sys.executable, "-c", code],
@@ -529,6 +544,14 @@ def main() -> None:
             result["lint_tree"] = _lint_bench()
         except Exception as e:
             result["lint_tree"] = {"error": repr(e)}
+
+    # Stamp the topology into every sub-bench row: a BENCH_*.json diff must
+    # never compare a pinned 8-core number against an unpinned 1-core one
+    # without seeing the difference in the row itself.
+    for key in ("micro", "collective", "recovery", "pipeline",
+                "llm_decode_throughput", "watchdog_overhead", "lint_tree"):
+        if isinstance(result.get(key), dict):
+            bench_rig.stamp(result[key], rig)
 
     if result.get("platform") == "tpu":
         result["source"] = "live"

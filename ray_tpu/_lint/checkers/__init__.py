@@ -12,5 +12,6 @@ from ray_tpu._lint.checkers import (  # noqa: F401
     config_drift,
     lock_discipline,
     metrics_hygiene,
+    no_flatten,
     tracer_hygiene,
 )

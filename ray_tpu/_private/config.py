@@ -239,6 +239,15 @@ _d("collective_virtual_nodes", int, 0,
    "hierarchical topology (>0 overrides real node placement, so a "
    "single-host world can exercise the two-level path)")
 
+# --- Bench rig (_private/bench_rig.py; read via os.environ each call so
+# --- benches can toggle mid-process, but declared here for dump/propagation)
+_d("bench_rig", bool, True,
+   "pin bench workers to dedicated cores where the box allows it; "
+   "0 = unpinned fallback everywhere, rows stamped pinned=false")
+_d("bench_pin_cpus", str, "",
+   "comma-separated CPU pool bench-run workers pin themselves to at "
+   "startup (exported by bench.py; empty = no pinning)")
+
 # --- Runtime environments ---
 _d("runtime_env_pip_no_index", bool, False,
    "pass --no-index to pip installs (hermetic/offline clusters)")

@@ -50,6 +50,7 @@ from ray_tpu._private.object_store import PlasmaClient
 from ray_tpu._private.reference_count import ReferenceCounter
 from ray_tpu._private.serialization import (
     SerializedObject,
+    freeze_buffers,
     get_serialization_context,
 )
 from ray_tpu._private.task_spec import (
@@ -76,6 +77,15 @@ from ray_tpu.exceptions import (
 logger = logging.getLogger(__name__)
 
 _FUNCTION_TABLE_THRESHOLD = 512 * 1024
+
+
+def _dumps_ctrl(obj) -> bytes:
+    """Control-plane pickle: error records, task specs, spec batches.
+    These are small, traverse RPC as opaque bytes, and flattening them IS
+    the wire format — the no-flatten rule guards payload buffers, not
+    these.  Protocol 5 so PickleBuffer inline args inside specs serialize
+    (in-band here; the rpc encoder takes large ones out-of-band)."""
+    return pickle.dumps(obj, protocol=5)  # lint: disable=no-flatten
 
 
 class _TaskContext(threading.local):
@@ -293,12 +303,19 @@ class CoreWorker:
         # Per-phase latency histogram for the task hot path (lazy init off
         # the hot path would race; one Histogram up front is cheap).
         from ray_tpu._private.metrics import (PHASE_SECONDS_BOUNDARIES,
-                                              Histogram)
+                                              Counter, Histogram)
 
         self._phase_hist = Histogram(
             "task_phase_seconds",
             "task hot-path time per phase (driver submit -> result wake)",
             boundaries=PHASE_SECONDS_BOUNDARIES)
+        # Defensive copies taken on the data plane (writable buffer inlined
+        # into a spec/return while the owner could still mutate it) — the
+        # zero-copy path's residual; should stay near zero for readonly
+        # payloads.
+        self._m_put_copies = Counter(
+            "put_copies_total",
+            "defensive buffer copies taken on the put/inline data plane")
         # Both modes push: the DRIVER owns the submit/stage/wake phases, so
         # without a driver push the phase breakdown never reaches the
         # nodelet's Prometheus scrape.
@@ -1053,13 +1070,19 @@ class CoreWorker:
                 await fut
         ok, value, err = self.memory_store.get_if_ready(oid)
         if err is not None:
-            return {"error": pickle.dumps(err)}
+            return {"error": _dumps_ctrl(err)}
         if value is IN_PLASMA:
             return {"plasma": True}
         if isinstance(value, SerializedObject):
-            return {"value": (value.inband, [bytes(b) for b in value.buffers])}
+            bufs, copied = freeze_buffers(value.buffers)
+            if copied:
+                self._m_put_copies.inc(copied)
+            return {"value": (value.inband, bufs)}
         ser = self.ctx.serialize(value)
-        return {"value": (ser.inband, [bytes(b) for b in ser.buffers])}
+        bufs, copied = freeze_buffers(ser.buffers)
+        if copied:
+            self._m_put_copies.inc(copied)
+        return {"value": (ser.inband, bufs)}
 
     async def rpc_object_status(self, conn, msg):
         oid = ObjectID(msg["oid"])
@@ -1315,7 +1338,10 @@ class CoreWorker:
                 holds.append(ref)
                 out.append(RefArg(ref.oid, ref.owner_addr(), ref.owner_worker_id()))
             else:
-                out.append(InlineArg(ser.inband, [bytes(b) for b in ser.buffers]))
+                bufs, copied = freeze_buffers(ser.buffers)
+                if copied:
+                    self._m_put_copies.inc(copied)
+                out.append(InlineArg(ser.inband, bufs))
         return out, kw_keys, holds
 
     def submit_task(self, fn, args, kwargs, *, name: str, num_returns: int,
@@ -1371,7 +1397,7 @@ class CoreWorker:
             trace_id=trace_id, span_id=span_id, parent_span_id=parent_span,
         )
         self.io.run(self.gcs_conn.call("create_actor", {
-            "spec": pickle.dumps(spec), "detached": detached,
+            "spec": _dumps_ctrl(spec), "detached": detached,
         }, timeout=RayConfig.gcs_rpc_timeout_s))
         # holds released once the actor is alive; keep it simple: creation args
         # stay pinned for the actor's lifetime via the submitter.
@@ -1759,7 +1785,7 @@ class CoreWorker:
             try:
                 result = invoke(item)
             except BaseException as e:  # never kill the chunk
-                result = {"status": "error", "error": pickle.dumps(
+                result = {"status": "error", "error": _dumps_ctrl(
                     RayTaskError.from_exception(spec.name, e))}
             loop.call_soon_threadsafe(
                 self._complete_chunk_item, spec, fut, result)
@@ -1817,13 +1843,13 @@ class CoreWorker:
                     # cancellation raise delivered outside the invoke proper
                     result = {"status": "error",
                               "cancelled": isinstance(e, TaskCancelledError),
-                              "error": pickle.dumps(
+                              "error": _dumps_ctrl(
                                   RayTaskError.from_exception(spec.name, e)
                                   if not isinstance(e, TaskCancelledError)
                                   else e)}
                 finally:
                     if result is None:  # belt: a raise past both handlers
-                        result = {"status": "error", "error": pickle.dumps(
+                        result = {"status": "error", "error": _dumps_ctrl(
                             RaySystemError("task result lost to a stray "
                                            "cancellation race"))}
                     deliver(spec, fut, result)
@@ -1863,7 +1889,7 @@ class CoreWorker:
         try:
             result = await self._execute_spec(spec)
         except BaseException as e:  # never kill the loop
-            result = {"status": "error", "error": pickle.dumps(
+            result = {"status": "error", "error": _dumps_ctrl(
                 RayTaskError.from_exception(spec.name, e))}
         finally:
             if release and self._actor_sem is not None:
@@ -1912,7 +1938,7 @@ class CoreWorker:
         try:
             result = dict(fut.result())
         except BaseException as e:  # never lose a completion
-            result = {"status": "error", "error": pickle.dumps(
+            result = {"status": "error", "error": _dumps_ctrl(
                 RayTaskError.from_exception(spec.name, e))}
         result["task_id"] = spec.task_id.binary()
         buf = self._done_buf.get(conn)
@@ -2022,7 +2048,7 @@ class CoreWorker:
                 err = RayActorError(spec.actor_id,
                                     f"actor has no method {spec.actor_method_name!r}"
                                     if self.actor_instance is not None else "actor not initialized")
-                return {"status": "error", "error": pickle.dumps(err)}
+                return {"status": "error", "error": _dumps_ctrl(err)}
             if asyncio.iscoroutinefunction(method):
                 return await self._invoke_async(spec, method)
             return await loop.run_in_executor(
@@ -2089,13 +2115,15 @@ class CoreWorker:
                             res = method(*vals, **kwargs)
                         except BaseException as e:
                             res = DagError(e)
-                    # one dumps per message, however many out edges
-                    payload = pickle.dumps(res, protocol=5)
+                    # one serialize per message, however many out edges; the
+                    # frame scatter-gathers into each channel with pickle-5
+                    # OOB buffers (no flatten)
+                    ser = self.ctx.serialize(res)
                     for o in node_outs:
-                        o.write_bytes(payload)
+                        o.write_serialized(ser)
             return self._pack_returns(spec, None)
         except BaseException as e:
-            return {"status": "error", "error": pickle.dumps(
+            return {"status": "error", "error": _dumps_ctrl(
                 RayTaskError.from_exception(spec.name, e))}
         finally:
             # ALWAYS propagate EOF downstream — an error path that skipped
@@ -2120,7 +2148,7 @@ class CoreWorker:
             # cancelled while queued on this worker: never starts
             self._cancelled_exec.discard(tkey)
             return {"status": "error", "cancelled": True,
-                    "error": pickle.dumps(TaskCancelledError(
+                    "error": _dumps_ctrl(TaskCancelledError(
                         f"task {spec.name} was cancelled before it started"))}
         self._running_threads[tkey] = threading.get_ident()
         try:
@@ -2131,15 +2159,15 @@ class CoreWorker:
                 try:
                     fn = self._load_function(spec)
                 except BaseException as e:
-                    return {"status": "error", "error": pickle.dumps(
+                    return {"status": "error", "error": _dumps_ctrl(
                         RayTaskError.from_exception(spec.name, e))}
                 return self._invoke_sync(spec, fn)
         except TaskCancelledError as e:
             return {"status": "error", "cancelled": True,
-                    "error": pickle.dumps(e)}
+                    "error": _dumps_ctrl(e)}
         except BaseException as e:  # env setup itself failed
             return {"status": "error",
-                    "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
+                    "error": _dumps_ctrl(RayTaskError.from_exception(spec.name, e))}
         finally:
             self._running_threads.pop(tkey, None)
             self._cancelled_exec.discard(tkey)
@@ -2154,7 +2182,7 @@ class CoreWorker:
             args, kwargs = self._resolve_args(spec)
         except BaseException as e:
             return {"status": "error",
-                    "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
+                    "error": _dumps_ctrl(RayTaskError.from_exception(spec.name, e))}
         self.task_ctx.task_id = spec.task_id
         self.task_ctx.job_id = spec.job_id
         self.task_ctx.actor_id = spec.actor_creation_id
@@ -2163,7 +2191,7 @@ class CoreWorker:
             self.actor_instance = cls(*args, **kwargs)
         except BaseException as e:
             return {"status": "error",
-                    "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
+                    "error": _dumps_ctrl(RayTaskError.from_exception(spec.name, e))}
         finally:
             # always restore: a failed constructor must not leave the
             # creation span as this executor thread's ambient context
@@ -2198,7 +2226,7 @@ class CoreWorker:
             # included): never starts
             self._cancelled_exec.discard(tkey)
             return {"status": "error", "cancelled": True,
-                    "error": pickle.dumps(TaskCancelledError(
+                    "error": _dumps_ctrl(TaskCancelledError(
                         f"task {spec.name} was cancelled before it started"))}
         self.task_ctx.task_id = spec.task_id
         self.task_ctx.job_id = spec.job_id
@@ -2237,7 +2265,7 @@ class CoreWorker:
             raise  # surfaces as a cancelled (non-retriable) completion
         except BaseException as e:
             return {"status": "error",
-                    "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
+                    "error": _dumps_ctrl(RayTaskError.from_exception(spec.name, e))}
         finally:
             self.task_ctx.task_id = None
             self._track_task_end(spec)
@@ -2250,7 +2278,7 @@ class CoreWorker:
             self._cancelled_exec.discard(tkey)
             _trace_ctx.reset(trace_token)
             return {"status": "error", "cancelled": True,
-                    "error": pickle.dumps(TaskCancelledError(
+                    "error": _dumps_ctrl(TaskCancelledError(
                         f"task {spec.name} was cancelled before it started"))}
         # thread=None: async tasks share the IO loop thread, so stack
         # attribution is via the running-task list, not a thread id
@@ -2268,7 +2296,7 @@ class CoreWorker:
                 self._running_async.pop(tkey, None)
                 self._cancelled_exec.discard(tkey)
                 return {"status": "error", "cancelled": True,
-                        "error": pickle.dumps(TaskCancelledError(
+                        "error": _dumps_ctrl(TaskCancelledError(
                             f"task {spec.name} was cancelled"))}
             try:
                 out = await method(*args, **kwargs)
@@ -2277,7 +2305,7 @@ class CoreWorker:
                 if cur is not None and hasattr(cur, "uncancel"):
                     cur.uncancel()  # absorb: the loop task must survive
                 return {"status": "error", "cancelled": True,
-                        "error": pickle.dumps(TaskCancelledError(
+                        "error": _dumps_ctrl(TaskCancelledError(
                             f"actor task {spec.name} was cancelled"))}
             finally:
                 self._running_async.pop(tkey, None)
@@ -2292,7 +2320,7 @@ class CoreWorker:
             return result
         except BaseException as e:
             return {"status": "error",
-                    "error": pickle.dumps(RayTaskError.from_exception(spec.name, e))}
+                    "error": _dumps_ctrl(RayTaskError.from_exception(spec.name, e))}
         finally:
             self._track_task_end(spec)
             _trace_ctx.reset(trace_token)
@@ -2333,8 +2361,10 @@ class CoreWorker:
         if ser.total_bytes() > RayConfig.max_direct_call_object_size:
             self.plasma.put_serialized(oid, ser)
             return (oid.binary(), "plasma", ser.total_bytes(), contained)
-        return (oid.binary(), "val", ser.inband,
-                [bytes(b) for b in ser.buffers], contained)
+        bufs, copied = freeze_buffers(ser.buffers)
+        if copied:
+            self._m_put_copies.inc(copied)
+        return (oid.binary(), "val", ser.inband, bufs, contained)
 
     def _pack_dynamic_returns(self, spec: TaskSpec, out) -> dict:
         """num_returns='dynamic': drain the generator; each yielded item
@@ -2370,8 +2400,10 @@ class CoreWorker:
             raise
         primary = spec.return_ids()[0]
         pser = self.ctx.serialize(metas)
-        returns.append((primary.binary(), "val", pser.inband,
-                        [bytes(b) for b in pser.buffers], ()))
+        pbufs, pcopied = freeze_buffers(pser.buffers)
+        if pcopied:
+            self._m_put_copies.inc(pcopied)
+        returns.append((primary.binary(), "val", pser.inband, pbufs, ()))
         return {"status": "ok", "returns": returns}
 
     def _pin_returned_ref(self, cref, token: bytes) -> None:
@@ -2532,7 +2564,10 @@ class NormalTaskSubmitter:
             if err is not None:
                 raise err
             if isinstance(value, SerializedObject) and not value.contained_refs:
-                spec.args[i] = InlineArg(value.inband, [bytes(b) for b in value.buffers])
+                bufs, copied = freeze_buffers(value.buffers)
+                if copied:
+                    self.cw._m_put_copies.inc(copied)
+                spec.args[i] = InlineArg(value.inband, bufs)
 
     async def _pump(self, key, st):
         # Pipelined dispatch: a lease accepts up to lease_pipeline_depth
@@ -2886,8 +2921,10 @@ class NormalTaskSubmitter:
                 self._normal_done(key, st, lease, s, h, item))
             self.cw._conn_tasks.setdefault(conn, set()).add(tkey)
         try:
+            # protocol 5: InlineArg buffers are PickleBuffers (zero-copy at
+            # build time); they serialize in-band here, one copy total.
             await conn.notify("push_task_batch",
-                              pickle.dumps([s for s, _ in items]))
+                              _dumps_ctrl([s for s, _ in items]))
         except (rpc.ConnectionLost, ConnectionError):
             # the close callback (or this sweep, if it already ran) delivers
             # synthetic 'lost' items for everything registered above
@@ -3087,7 +3124,7 @@ class ActorTaskSubmitter:
             try:
                 await conn.notify(
                     "push_task_batch",
-                    pickle.dumps([spec for spec, _ in shipped]))
+                    _dumps_ctrl([spec for spec, _ in shipped]))
             except (rpc.ConnectionLost, ConnectionError):
                 # the close callback retries/fails every inflight (incl. this
                 # batch); nothing more to do here

@@ -109,7 +109,7 @@ class PlacementGroupManager:
                 store.delete("placement_groups", pg.pg_id.hex())
             else:
                 store.put("placement_groups", pg.pg_id.hex(),
-                          pickle.dumps(pg.to_record()))
+                          pickle.dumps(pg.to_record()))  # lint: disable=no-flatten (KV record)
 
     def load_from_store(self, store):
         if not store.persistent:

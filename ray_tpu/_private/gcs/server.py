@@ -232,13 +232,14 @@ class GcsServer:
             import pickle
 
             self.store.put("actors", info.actor_id.hex(),
-                           pickle.dumps(info.to_record()))
+                           pickle.dumps(info.to_record()))  # lint: disable=no-flatten (KV record)
 
     def _persist_job(self, rec: dict):
         if self.store.persistent:
             import pickle
 
-            self.store.put("jobs", rec["job_id"].hex(), pickle.dumps(rec))
+            self.store.put("jobs", rec["job_id"].hex(),
+                           pickle.dumps(rec))  # lint: disable=no-flatten (KV record)
 
     async def _confirmation_sweep(self):
         """After a restart, actors whose node never re-reported them within

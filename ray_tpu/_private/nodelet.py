@@ -1566,7 +1566,7 @@ class Nodelet:
                 # rather than retrying the broken env forever
                 return {"ok": False,
                         "reason": f"runtime env setup failed: {e}",
-                        "error": pickle.dumps(RuntimeEnvSetupError(
+                        "error": pickle.dumps(RuntimeEnvSetupError(  # lint: disable=no-flatten (error record)
                             f"runtime env setup failed: {e}"))}
         w = await self._pop_worker(env_key=env_key)
         self._lease_seq += 1

@@ -35,6 +35,7 @@ import asyncio
 import collections
 import logging
 import os
+import pickle
 import threading
 import time
 from multiprocessing import resource_tracker, shared_memory
@@ -353,6 +354,12 @@ class PlasmaStore:
         while granted < want and len(extents) < 8:
             more = self._arena_find(min(_align(want - granted), contig))
             if more is None:
+                # Top-up stays strictly opportunistic: free extents in
+                # existing slabs only.  Creating slabs here was measured
+                # SLOWER on a put storm — each new slab pays a full
+                # pre-fault zeroing pass, which costs more than the lease
+                # RPC it saves (and at the capacity line the grow path
+                # starts unlinking/recreating pre-faulted slabs, churning).
                 break
             take = min(_align(want - granted), contig)
             extents.append((more[0], more[1], take))
@@ -1179,7 +1186,13 @@ class RemotePlasmaClient:
         total = ser.total_frame_bytes()
         chunk = RayConfig.fetch_chunk_bytes
         if total <= chunk:
-            self._put_bytes(oid, memoryview(ser.to_bytes()))
+            # One scatter-gather write into a single frame buffer; the RPC
+            # layer then ships it out-of-band (PickleBuffer) — exactly one
+            # copy on this side instead of the old to_bytes() + bytes()
+            # double cast.
+            flat = bytearray(total)
+            ser.write_into(flat)
+            self._put_bytes(oid, memoryview(flat))
             return
         deadline = time.monotonic() + 30.0
         while True:
@@ -1197,9 +1210,14 @@ class RemotePlasmaClient:
         try:
             off = 0
             for part in ser.iter_frame(chunk):
+                # PickleBuffer rides the RPC pickle-5 out-of-band path: the
+                # chunk is written to the socket segment-wise, never
+                # flattened into an intermediate bytes.  call_sync blocks
+                # until the reply, so the source view stays live for the
+                # whole write.
                 self._conn.call_sync("plasma_put_chunk",
                                      {"oid": oid.binary(), "offset": off,
-                                      "data": bytes(part)})
+                                      "data": pickle.PickleBuffer(part)})
                 off += part.nbytes
             self._conn.call_sync("plasma_seal", {"oid": oid.binary()})
         except BaseException:
@@ -1225,7 +1243,7 @@ class RemotePlasmaClient:
                 if data.nbytes <= chunk:
                     self._conn.call_sync("plasma_put_bytes",
                                          {"oid": oid.binary(),
-                                          "data": bytes(data)})
+                                          "data": pickle.PickleBuffer(data)})
                     return
                 resp = self._conn.call_sync("plasma_put_begin",
                                             {"oid": oid.binary(),
@@ -1243,7 +1261,7 @@ class RemotePlasmaClient:
                 part = data[off:off + chunk]
                 self._conn.call_sync("plasma_put_chunk",
                                      {"oid": oid.binary(), "offset": off,
-                                      "data": bytes(part)})
+                                      "data": pickle.PickleBuffer(part)})
                 off += part.nbytes
             self._conn.call_sync("plasma_seal", {"oid": oid.binary()})
         except BaseException:

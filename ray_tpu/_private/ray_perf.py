@@ -69,6 +69,18 @@ def _settle(seconds: float = 0.5) -> None:
 def run_microbenchmarks(duration_s: float = 2.0,
                         large_put_mb: int = 64) -> Dict[str, float]:
     import ray_tpu
+    from ray_tpu._private import bench_rig
+    from ray_tpu._private.metrics import Gauge
+
+    # Pin the driver side of every 1:1 ping-pong below; runtime workers pin
+    # themselves via worker_main when RAY_TPU_BENCH_PIN_CPUS is exported.
+    rig = bench_rig.metadata()
+    if rig["pinned"]:
+        bench_rig.pin_self(bench_rig.available_cpus()[0])
+    Gauge("bench_pinned",
+          "1 when the last bench run pinned its workers to dedicated "
+          "cores, 0 for the unpinned fallback").set(
+              1.0 if rig["pinned"] else 0.0)
 
     @ray_tpu.remote
     def noop():
@@ -243,6 +255,9 @@ def run_microbenchmarks(duration_s: float = 2.0,
     results = {k: (round(v, 2) if isinstance(v, float) else v)
                for k, v in results.items()}
     results.update(results_vs)
+    # every bench row carries its topology: numbers from an unpinned 1-core
+    # box and a pinned 8-core rig must never be diffed as equals
+    bench_rig.stamp(results, rig)
     return results
 
 

@@ -97,11 +97,15 @@ class SerializedObject:
                 else:
                     pending.extend(mv[off:off + take])
                     if len(pending) == chunk_bytes:
-                        yield memoryview(bytes(pending))
-                        pending.clear()
+                        # Swap instead of copy: the filled bytearray is
+                        # yielded as-is and a fresh one accumulates the next
+                        # tail, so each stitched chunk costs exactly the one
+                        # extend() copy.
+                        out, pending = pending, bytearray()
+                        yield memoryview(out)
                 off += take
         if pending:
-            yield memoryview(bytes(pending))
+            yield memoryview(pending)
 
     @classmethod
     def from_buffer(cls, buf) -> "SerializedObject":
@@ -121,6 +125,26 @@ class SerializedObject:
         return cls(inband, buffers)
 
 
+def freeze_buffers(buffers) -> Tuple[List[Any], int]:
+    """Prepare OOB buffers for an in-flight frame (inline args, packed
+    returns): readonly views pass through zero-copy as ``PickleBuffer``s
+    (protocol-5 picklable; the RPC encoder's buffer_callback ships them
+    out-of-band, so they never flatten); writable views are copied,
+    because the owner can mutate the backing array between submission and
+    the asynchronous wire write.  Returns (buffers, n_copied) so callers
+    can count residual copies."""
+    out: List[Any] = []
+    copied = 0
+    for b in buffers:
+        mv = b if isinstance(b, memoryview) else memoryview(b)
+        if mv.readonly:
+            out.append(pickle.PickleBuffer(mv))
+        else:
+            out.append(bytes(mv))
+            copied += 1
+    return out, copied
+
+
 class SerializationContext:
     """Per-process serializer with a custom-reducer registry.
 
@@ -132,6 +156,7 @@ class SerializationContext:
         self._custom: Dict[type, Tuple[Callable, Callable]] = {}
         self._lock = threading.Lock()
         self._jax_registered = False
+        self._pickler_cls = None
 
     def register_serializer(self, cls: type, serializer: Callable, deserializer: Callable):
         with self._lock:
@@ -142,26 +167,42 @@ class SerializationContext:
             self._custom.pop(cls, None)
 
     def _make_pickler(self, file, buffer_callback):
-        custom = self._custom
+        # Cache the Pickler subclass: creating a class per serialize() call
+        # costs more than the pickling itself for small hot-path messages
+        # (compiled-DAG channel frames).  The closure captures the _custom
+        # dict by reference, so later register_serializer calls are seen.
+        cls = self._pickler_cls
+        if cls is None:
+            custom = self._custom
 
-        class _Pickler(cloudpickle.Pickler):
-            def reducer_override(self, obj):  # noqa: N802
-                entry = custom.get(type(obj))
-                if entry is None:
-                    for base in type(obj).__mro__[1:]:
-                        entry = custom.get(base)
-                        if entry is not None:
-                            break
-                if entry is not None:
-                    serializer, deserializer = entry
-                    return (_apply_deserializer, (deserializer, serializer(obj)))
-                # Chain to cloudpickle's own reducer_override (it handles
-                # functions/classes by value) rather than disabling it.
-                return super().reducer_override(obj)
+            class _Pickler(cloudpickle.Pickler):
+                def reducer_override(self, obj):  # noqa: N802
+                    entry = custom.get(type(obj))
+                    if entry is None:
+                        for base in type(obj).__mro__[1:]:
+                            entry = custom.get(base)
+                            if entry is not None:
+                                break
+                    if entry is not None:
+                        serializer, deserializer = entry
+                        return (_apply_deserializer,
+                                (deserializer, serializer(obj)))
+                    # Chain to cloudpickle's own reducer_override (it handles
+                    # functions/classes by value) rather than disabling it.
+                    return super().reducer_override(obj)
 
-        return _Pickler(file, protocol=5, buffer_callback=buffer_callback)
+            cls = self._pickler_cls = _Pickler
+
+        return cls(file, protocol=5, buffer_callback=buffer_callback)
 
     def serialize(self, value: Any) -> SerializedObject:
+        # Fast path: scalar-ish builtins cannot contain ObjectRefs, OOB
+        # buffers, or custom-reduced objects — plain pickle, no cloudpickle
+        # Pickler construction (this is the compiled-DAG per-message path).
+        t = type(value)
+        if t in _FAST_TYPES and t not in self._custom:
+            return SerializedObject(  # scalars: no buffers exist to flatten
+                pickle.dumps(value, protocol=5), [])  # lint: disable=no-flatten
         if not self._jax_registered:
             import sys
 
@@ -192,6 +233,9 @@ class SerializationContext:
         return pickle.loads(serialized.inband, buffers=serialized.buffers)
 
 
+_FAST_TYPES = (int, float, bool, type(None), str)
+
+
 def _apply_deserializer(deserializer, payload):
     return deserializer(payload)
 
@@ -216,10 +260,13 @@ _default_lock = threading.Lock()
 
 def get_serialization_context() -> SerializationContext:
     global _default_context
-    with _default_lock:
-        if _default_context is None:
-            _default_context = SerializationContext()
-        return _default_context
+    ctx = _default_context
+    if ctx is None:
+        with _default_lock:
+            ctx = _default_context
+            if ctx is None:
+                ctx = _default_context = SerializationContext()
+    return ctx
 
 
 def maybe_register_jax(ctx: Optional[SerializationContext] = None) -> None:
@@ -239,7 +286,16 @@ def maybe_register_jax(ctx: Optional[SerializationContext] = None) -> None:
     import numpy as np
 
     def _ser_jax(arr):
-        return np.asarray(jax.device_get(arr))
+        # device_get already returns a numpy array for host-backed arrays;
+        # asarray on top of that would be a redundant full copy.  Only
+        # materialize when needed, and keep the result C-contiguous so the
+        # pickle-5 buffer_callback can take it out-of-band.
+        out = jax.device_get(arr)
+        if not isinstance(out, np.ndarray):
+            out = np.asarray(out)
+        if not out.flags.c_contiguous:
+            out = np.ascontiguousarray(out)
+        return out
 
     def _deser_jax(np_arr):
         return np_arr

@@ -31,10 +31,15 @@ class TaskType(enum.IntEnum):
 
 @dataclass
 class InlineArg:
-    """A small argument serialized in-band (reference: 'passed by value')."""
+    """A small argument serialized in-band (reference: 'passed by value').
+
+    ``buffers`` holds ``bytes`` (defensive copies of writable sources) or
+    ``pickle.PickleBuffer`` views (readonly sources, zero-copy until the
+    wire pickle); specs carrying PickleBuffers must be pickled with
+    protocol 5."""
 
     inband: bytes
-    buffers: List[bytes] = field(default_factory=list)
+    buffers: List[Any] = field(default_factory=list)
 
 
 @dataclass

@@ -40,11 +40,35 @@ import time
 from multiprocessing import resource_tracker, shared_memory
 from typing import Any, Optional
 
+from ray_tpu._private.serialization import (SerializedObject,
+                                            get_serialization_context)
+
 _HDR = 40
 _SLOT_HDR = 8
 
 # Sentinel lengths (no payload).
 _LEN_CLOSE = (1 << 64) - 1
+
+# First byte of a SerializedObject channel frame.  A protocol-5 pickle
+# always starts with the PROTO opcode (0x80), so a reader can tell the two
+# payload kinds apart and stay compatible with raw-pickle producers
+# (write_bytes of pickle.dumps output, e.g. compiled-DAG error frames).
+_SER_FRAME_MAGIC = 0x93
+
+# Chunk size for scatter-gather TCP sends: large OOB buffers are sliced
+# zero-copy, only sub-chunk header/tail pieces get stitched.
+_TCP_CHUNK = 256 * 1024
+
+
+def _loads_payload(payload) -> Any:
+    """Decode one channel payload.  SerializedObject frames (magic byte)
+    deserialize through the SerializationContext with buffer views aliasing
+    ``payload`` — zero further copies; anything else is a raw pickle from a
+    legacy ``write_bytes`` producer."""
+    if payload and payload[0] == _SER_FRAME_MAGIC:
+        ser = SerializedObject.from_buffer(memoryview(payload)[1:])
+        return get_serialization_context().deserialize(ser)
+    return pickle.loads(payload)
 
 
 class ChannelClosed(Exception):
@@ -211,7 +235,35 @@ class ShmChannel:
         self._set_head(head + 1)
 
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
-        self.write_bytes(pickle.dumps(value, protocol=5), timeout)
+        self.write_serialized(get_serialization_context().serialize(value),
+                              timeout)
+
+    def write_serialized(self, ser, timeout: Optional[float] = None) -> None:
+        """Scatter-gather a SerializedObject frame (pickle-5 out-of-band
+        buffers) straight into the ring slot: one memcpy per source buffer
+        into shared memory, no intermediate pickle flatten.  Works in both
+        native and pure-Python modes — the ring layout is shared, and the
+        head publish below matches _bump's tolerated-lost-increment futex
+        semantics."""
+        if not ser.buffers:
+            # no OOB buffers: the in-band pickle IS the whole payload, and
+            # the raw-pickle wire form (0x80 first byte) is cheaper than a
+            # frame for the small-message hot path
+            self.write_bytes(ser.inband, timeout)
+            return
+        n = 1 + ser.total_frame_bytes()
+        if n > self.slot_size:
+            raise ChannelFull(
+                f"message of {n} bytes exceeds channel slot size "
+                f"{self.slot_size}; recompile with a larger max_buf")
+        self.wait_writable(timeout)
+        head = self._head()
+        off = self._slot(head)
+        buf = self._shm.buf
+        buf[off + _SLOT_HDR] = _SER_FRAME_MAGIC
+        ser.write_into(buf[off + _SLOT_HDR + 1:off + _SLOT_HDR + n])
+        buf[off:off + _SLOT_HDR] = n.to_bytes(8, "little")
+        self._set_head(head + 1)
 
     def close_write(self, timeout: float = 60.0) -> None:
         """Producer EOF: wakes the consumer with a close sentinel.  Waits
@@ -257,7 +309,37 @@ class ShmChannel:
         return payload
 
     def read(self, timeout: Optional[float] = None) -> Any:
-        return pickle.loads(self.read_bytes(timeout))
+        """Copy the payload out of the slot ONCE, advance the tail, then
+        deserialize with buffer views aliasing that private copy — the slot
+        is reused as soon as the tail advances, so deserialized arrays must
+        not alias it."""
+        if self._lib is not None:
+            cn = ctypes.c_uint64()
+            rc = self._native_wait(self._lib.ch_wait_readable, timeout,
+                                   ctypes.byref(cn))
+            if rc != 0:
+                raise TimeoutError("channel wait timed out")
+            n = cn.value
+            if n == _LEN_CLOSE:
+                self._lib.ch_advance_tail(self._cbuf)
+                raise ChannelClosed("producer closed the channel")
+            tail = self._tail()
+            off = self._slot(tail)
+            payload = bytearray(
+                self._shm.buf[off + _SLOT_HDR:off + _SLOT_HDR + n])
+            self._lib.ch_advance_tail(self._cbuf)
+        else:
+            tail = self._tail()
+            self._wait(lambda: self._head() > tail, timeout)
+            off = self._slot(tail)
+            buf = self._shm.buf
+            n = int.from_bytes(buf[off:off + _SLOT_HDR], "little")
+            if n == _LEN_CLOSE:
+                self._set_tail(tail + 1)
+                raise ChannelClosed("producer closed the channel")
+            payload = bytearray(buf[off + _SLOT_HDR:off + _SLOT_HDR + n])
+            self._set_tail(tail + 1)
+        return _loads_payload(payload)
 
     # ----------------------------------------------------------- lifecycle
     def close(self) -> None:
@@ -396,7 +478,7 @@ class TcpChannel:
             adv = advertise_host if advertise_host not in ("", "0.0.0.0") \
                 else "127.0.0.1"
             _kv_call("kv_put", {"ns": _KV_NS, "key": name,
-                                "value": pickle.dumps((adv, port))})
+                                "value": pickle.dumps((adv, port))})  # lint: disable=no-flatten (rendezvous record)
             self._registered = True
 
     # ---------------------------------------------------------- connection
@@ -511,7 +593,24 @@ class TcpChannel:
         self._credits -= 1
 
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
-        self.write_bytes(pickle.dumps(value, protocol=5), timeout)
+        self.write_serialized(get_serialization_context().serialize(value),
+                              timeout)
+
+    def write_serialized(self, ser, timeout: Optional[float] = None) -> None:
+        """Send a SerializedObject frame scatter-gather: large OOB buffers
+        go to sendall as zero-copy slices, only sub-chunk header/tail pieces
+        are stitched (iter_frame) — no flattened intermediate payload."""
+        if not ser.buffers:
+            self.write_bytes(ser.inband, timeout)
+            return
+        self.wait_writable(timeout)
+        n = 1 + ser.total_frame_bytes()
+        self._sock.settimeout(None)
+        self._sock.sendall(n.to_bytes(8, "little")
+                           + bytes((_SER_FRAME_MAGIC,)))
+        for part in ser.iter_frame(_TCP_CHUNK):
+            self._sock.sendall(part)
+        self._credits -= 1
 
     def close_write(self, timeout: float = 60.0) -> None:
         try:
@@ -539,7 +638,39 @@ class TcpChannel:
         return payload
 
     def read(self, timeout: Optional[float] = None) -> Any:
-        return pickle.loads(self.read_bytes(timeout))
+        """Receive straight into one preallocated buffer (recv_into, no
+        join copy) and deserialize with views aliasing it."""
+        self._ensure_conn(timeout)
+        head = self._recv_exact(8, timeout)
+        n = int.from_bytes(head, "little")
+        if n == _LEN_CLOSE:
+            raise ChannelClosed("producer closed the channel")
+        payload = self._recv_into(n, timeout)
+        self._sock.settimeout(None)
+        self._sock.sendall(b"\x01")  # return one credit
+        return _loads_payload(payload)
+
+    def _recv_into(self, n: int, timeout: Optional[float]) -> bytearray:
+        import socket
+
+        self._sock.settimeout(timeout)
+        out = bytearray(n)
+        mv = memoryview(out)
+        got = 0
+        try:
+            while got < n:
+                r = self._sock.recv_into(mv[got:], min(n - got, 1 << 20))
+                if not r:
+                    raise ChannelClosed(
+                        f"tcp channel {self.name}: peer disconnected")
+                got += r
+        except socket.timeout:
+            # mid-frame timeout would desync the stream; fail hard
+            raise ChannelClosed(
+                f"tcp channel {self.name}: truncated frame")
+        finally:
+            mv.release()
+        return out
 
     # ----------------------------------------------------------- lifecycle
     def close(self) -> None:

@@ -272,6 +272,107 @@ class TestArenaClientServer:
         assert store.arena_free_bytes() > leased_before
 
 
+# ------------------------------------------------------- zero-copy get path
+class TestZeroCopyGet:
+    """Buffer identity + aliasing safety for the get path: a numpy array
+    deserialized from plasma must be BACKED by the client's arena mapping
+    (no hidden flatten/copy between seal and deserialize), and the
+    pin-until-last-view / zombie-extent machinery must keep that aliased
+    memory valid against puts, deletes, and extent reuse."""
+
+    @pytest.fixture
+    def env(self, small_slabs):
+        io = rpc.EventLoopThread()
+        store = PlasmaStore(capacity_bytes=32 * MB)
+        handlers, waiters = {}, {}
+        register_store_handlers(handlers, store, waiters)
+        server = rpc.Server(handlers, name="store")
+        host, port = io.run(server.start())
+        conn = io.run(rpc.connect(host, port))
+        client = PlasmaClient(io, conn)
+        yield io, store, client, server, conn
+        client.close()
+        io.run(conn.close())
+        io.run(server.stop())
+        store.shutdown()
+        io.stop()
+
+    @staticmethod
+    def _get(client, ctx, o):
+        mv = client.get_mapped(o, timeout=5)
+        assert mv is not None
+        ser = SerializedObject.from_buffer(mv)
+        ser.buffers = client.wrap_views(o, ser.buffers)
+        return ctx.deserialize(ser)
+
+    def test_get_array_is_backed_by_mapped_extent(self, env):
+        io, store, client, server, conn = env
+        ctx = get_serialization_context()
+        arr = np.arange(64 * 1024, dtype=np.int64)
+        o = oid(11)
+        client.put_serialized(o, ctx.serialize(arr))
+        out = self._get(client, ctx, o)
+        np.testing.assert_array_equal(out, arr)
+        # identity, not equality: the array's data pointer must lie inside
+        # the client's mapping of the slab that holds the sealed extent
+        slab, size, off = store.get_local(o, pin=False)
+        shm = client._maps[slab]
+        base = np.frombuffer(shm.buf, dtype=np.uint8)
+        slab_addr = base.__array_interface__["data"][0]
+        arr_addr = out.__array_interface__["data"][0]
+        assert slab_addr + off <= arr_addr < slab_addr + off + size, \
+            "deserialized array is a copy, not a view of the arena extent"
+        del base
+        # and it really is the SAME memory: a store-side write through the
+        # server's own mapping shows through the client's array
+        patch = np.int64(-12345).tobytes()
+        store.slabs[slab].shm.buf[off + size - 8:off + size] = patch
+        assert out[-1] == -12345
+        del out
+        client.release(o)
+
+    def test_mutating_source_after_put_is_isolated(self, env):
+        """put_serialized copies into the arena before returning: mutating
+        the source array afterwards must not corrupt the sealed object."""
+        io, store, client, server, conn = env
+        ctx = get_serialization_context()
+        arr = np.arange(16 * 1024, dtype=np.int64)
+        o = oid(12)
+        client.put_serialized(o, ctx.serialize(arr))
+        arr[:] = -1  # owner mutates its buffer after the put returned
+        out = self._get(client, ctx, o)
+        np.testing.assert_array_equal(out, np.arange(16 * 1024, dtype=np.int64))
+        del out
+        client.release(o)
+
+    def test_view_survives_delete_and_extent_reuse_pressure(self, env):
+        """Owner release/delete while a reader still aliases the extent:
+        the extent parks as a zombie, is not handed to new puts, and the
+        view keeps seeing its bytes until the last view dies."""
+        io, store, client, server, conn = env
+        ctx = get_serialization_context()
+        arr = np.full(32 * 1024, 7, dtype=np.int64)
+        o = oid(13)
+        client.put_serialized(o, ctx.serialize(arr))
+        out = self._get(client, ctx, o)  # reader view pins the extent
+        store.delete(o)  # owner deletes while the view is live
+        assert not store.contains(o)
+        assert store.stats()["zombie_extents"] >= 1
+        # pressure: new puts must carve fresh extents, not the zombie
+        for i in range(6):
+            client.put_serialized(
+                oid(100 + i), ctx.serialize(np.zeros(64 * 1024, np.int64)))
+        assert bool((out == 7).all()), \
+            "zombie extent was reused under a live reader view"
+        del out
+        client.release(o)
+        deadline = time.monotonic() + 10
+        while store.stats()["zombie_extents"] > 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert store.stats()["zombie_extents"] == 0
+
+
 # -------------------------------------------------------- remote (ray://)
 class TestRemoteStreamingPut:
     def test_iter_frame_matches_to_bytes(self):

@@ -1,0 +1,93 @@
+"""Bench-rig smoke tests: topology detection, graceful 1-core fallback,
+row stamping, and the worker self-pin hook (ISSUE 12 tentpole d)."""
+
+import os
+
+import pytest
+
+from ray_tpu._private import bench_rig
+
+
+def test_metadata_shape_and_types():
+    md = bench_rig.metadata()
+    assert set(md) == {"num_cpus", "pinned", "cgroup_cpu_quota"}
+    assert isinstance(md["num_cpus"], int) and md["num_cpus"] >= 1
+    assert isinstance(md["pinned"], bool)
+    assert md["cgroup_cpu_quota"] is None or md["cgroup_cpu_quota"] > 0
+
+
+def test_available_cpus_matches_affinity():
+    cpus = bench_rig.available_cpus()
+    assert cpus == sorted(set(cpus))
+    if hasattr(os, "sched_getaffinity"):
+        assert set(cpus) == os.sched_getaffinity(0)
+
+
+def test_plan_pins_fallback_and_assignment():
+    plan = bench_rig.plan_pins(4)
+    assert len(plan) == 4
+    if bench_rig.can_pin(4):
+        cpus = set(bench_rig.available_cpus())
+        assert all(c in cpus for c in plan)
+    else:
+        # 1-core box / rig off: unpinned, but the plan still exists
+        assert plan == [None] * 4
+
+
+def test_stamp_adds_rig_keys_without_clobbering():
+    row = {"value": 1.0, "num_cpus": 99}
+    bench_rig.stamp(row)
+    assert row["num_cpus"] == 99  # a row's own measurement wins
+    assert "pinned" in row and "cgroup_cpu_quota" in row
+    # non-dict rows pass through untouched
+    assert bench_rig.stamp(None) is None
+
+
+def test_rig_disable_env(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_BENCH_RIG", "0")
+    assert not bench_rig.rig_enabled()
+    assert not bench_rig.can_pin(2)
+    assert bench_rig.metadata()["pinned"] is False
+    assert bench_rig.pin_env(8) == {}
+
+
+def test_pin_self_never_raises():
+    # pinning to our own current CPU must succeed where supported...
+    cpus = bench_rig.available_cpus()
+    if hasattr(os, "sched_setaffinity"):
+        before = os.sched_getaffinity(0)
+        try:
+            assert bench_rig.pin_self(cpus[0]) is True
+        finally:
+            os.sched_setaffinity(0, before)
+    # ...and a bogus target degrades to False, not an exception
+    assert bench_rig.pin_self(None) is False
+    assert bench_rig.pin_self(10_000) is False
+
+
+def test_maybe_pin_from_env(monkeypatch):
+    if not hasattr(os, "sched_setaffinity"):
+        pytest.skip("no affinity syscall on this platform")
+    before = os.sched_getaffinity(0)
+    cpus = bench_rig.available_cpus()
+    try:
+        monkeypatch.setenv("RAY_TPU_BENCH_PIN_CPUS",
+                           ",".join(str(c) for c in cpus))
+        assert bench_rig.maybe_pin_from_env() in cpus
+        # malformed pool: no pin, no crash
+        monkeypatch.setenv("RAY_TPU_BENCH_PIN_CPUS", "a,b")
+        assert bench_rig.maybe_pin_from_env() is None
+        monkeypatch.setenv("RAY_TPU_BENCH_PIN_CPUS", "")
+        assert bench_rig.maybe_pin_from_env() is None
+    finally:
+        os.sched_setaffinity(0, before)
+
+
+def test_run_pinned_workers_collects_results():
+    out = bench_rig.run_pinned_workers(_worker_square, [(2,), (3,), (4,)],
+                                       timeout_s=60.0)
+    assert out == [4, 9, 16]
+
+
+def _worker_square(x):
+    return x * x

@@ -607,3 +607,63 @@ links["act_in"].recv("0.a0")
                       baseline=None)
     assert rules_of(result.findings) == ["collective-timeout.call"]
     assert "`recv`" in result.findings[0].message
+
+
+# ===================================================== no-flatten
+
+
+def test_no_flatten_positives():
+    src = '''
+import pickle
+
+def ship(arr, ser):
+    a = pickle.dumps(arr)                       # flatten: no buffer_callback
+    b = arr.tobytes()                           # full-buffer copy
+    c = ser.to_bytes()                          # frame flatten
+    return a, b, c
+'''
+    rules = rules_of(lint_source(
+        src, ["no-flatten"], filename="ray_tpu/_private/snippet.py"))
+    assert rules == ["no-flatten.dumps", "no-flatten.tobytes",
+                     "no-flatten.to_bytes"]
+
+
+def test_no_flatten_negatives():
+    src = '''
+import pickle
+
+def ship(arr, ser, dest, n):
+    bufs = []
+    a = pickle.dumps(arr, protocol=5, buffer_callback=bufs.append)
+    ser.write_into(dest)                        # scatter-gather: the point
+    hdr = n.to_bytes(8, "little")               # int wire framing: fine
+    hdr2 = n.to_bytes(length=8, byteorder="little")
+    return a, hdr, hdr2
+'''
+    assert lint_source(src, ["no-flatten"],
+                       filename="ray_tpu/_private/snippet.py") == []
+
+
+def test_no_flatten_scoped_to_data_plane_dirs():
+    src = '''
+import pickle
+payload = pickle.dumps({"x": 1})
+'''
+    # same code: flagged inside the zero-copy dirs, ignored above them
+    for scoped in ("ray_tpu/_private/x.py", "ray_tpu/dag/x.py",
+                   "ray_tpu/experimental/x.py",
+                   "ray_tpu/util/collective/x.py"):
+        assert rules_of(lint_source(src, ["no-flatten"], filename=scoped)) \
+            == ["no-flatten.dumps"]
+    for unscoped in ("ray_tpu/serve/x.py", "ray_tpu/train/x.py",
+                     "tests/x.py"):
+        assert lint_source(src, ["no-flatten"], filename=unscoped) == []
+
+
+def test_no_flatten_suppression():
+    src = '''
+import pickle
+rec = pickle.dumps({"k": "v"})  # lint: disable=no-flatten (KV record)
+'''
+    assert lint_source(src, ["no-flatten"],
+                       filename="ray_tpu/_private/x.py") == []
