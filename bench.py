@@ -535,6 +535,48 @@ def main() -> None:
         except Exception as e:
             result["pipeline_error"] = repr(e)
 
+    # Serving-at-scale rows (ISSUE 13): prefix-cache prefill reduction,
+    # chunked-prefill ITL A/B, and the SSE load harness (hundreds of
+    # concurrent streams against a 2-replica deployment through the real
+    # HTTP proxy).  Subprocess so the serve runtime can't leak into later
+    # sections.
+    if os.environ.get("RAY_TPU_BENCH_SERVE", "1") != "0":
+        import subprocess
+        import sys
+
+        code = ("import json, ray_tpu; from ray_tpu._private.ray_perf "
+                "import host_cpu_count; "
+                "from ray_tpu._private.serve_load_bench "
+                "import run_serve_load_bench; "
+                "ray_tpu.init(num_cpus=max(host_cpu_count(), 4), "
+                "object_store_memory=1024**3); "
+                "print('SERVE_LOAD=' + json.dumps(run_serve_load_bench()))")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.update(rig_env)
+        try:
+            proc = subprocess.Popen([sys.executable, "-c", code],
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True,
+                                    env=env, start_new_session=True)
+            try:
+                stdout, stderr = proc.communicate(timeout=540)
+            except subprocess.TimeoutExpired:
+                import signal
+
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait()
+                raise
+            for line in stdout.splitlines():
+                if line.startswith("SERVE_LOAD="):
+                    result["serve_load"] = json.loads(
+                        line[len("SERVE_LOAD="):])
+                    break
+            else:
+                result["serve_load_error"] = (stderr or "no output")[-500:]
+        except Exception as e:
+            result["serve_load_error"] = repr(e)
+
     # Lint gate wall-clock (ISSUE 5): `ray_tpu lint` runs as a tier-1 test
     # on every PR; record its full-tree cost so the gate visibly stays
     # inside its < 10 s CPU budget instead of quietly becoming the slow
@@ -549,7 +591,8 @@ def main() -> None:
     # never compare a pinned 8-core number against an unpinned 1-core one
     # without seeing the difference in the row itself.
     for key in ("micro", "collective", "recovery", "pipeline",
-                "llm_decode_throughput", "watchdog_overhead", "lint_tree"):
+                "llm_decode_throughput", "watchdog_overhead", "lint_tree",
+                "serve_load"):
         if isinstance(result.get(key), dict):
             bench_rig.stamp(result[key], rig)
 

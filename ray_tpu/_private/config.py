@@ -247,6 +247,9 @@ _d("bench_rig", bool, True,
 _d("bench_pin_cpus", str, "",
    "comma-separated CPU pool bench-run workers pin themselves to at "
    "startup (exported by bench.py; empty = no pinning)")
+_d("bench_serve_streams", int, 256,
+   "concurrent SSE streams the serve_load bench drives against the "
+   "2-replica llm_deployment")
 
 # --- Runtime environments ---
 _d("runtime_env_pip_no_index", bool, False,

@@ -296,14 +296,25 @@ def summarize_llm(samples: List[Sample]) -> Dict[str, Dict[str, float]]:
     ttft = _hist_by(samples, "ray_tpu_llm_ttft_seconds", keys)
     itl = _hist_by(samples, "ray_tpu_llm_inter_token_seconds", keys)
     batch = _hist_by(samples, "ray_tpu_llm_decode_batch_size", keys)
+    prefill = _sum_by(samples, "ray_tpu_llm_prefill_tokens_total", keys)
+    hits = _sum_by(samples, "ray_tpu_llm_prefix_cache_hit_tokens_total",
+                   keys)
+    ppages = _max_by(samples, "ray_tpu_llm_prefix_cache_pages", keys)
+    # shed carries a reason label; fold it away for the per-engine total
+    shed = _sum_by(samples, "ray_tpu_llm_shed_total", keys)
+    qwait = _hist_by(samples, "ray_tpu_llm_queue_wait_seconds", keys)
     out: Dict[str, Dict[str, float]] = {}
     for joined, k in _joined(set(req) | set(toks) | set(ptoks) | set(queue)
                              | set(running) | set(util) | set(tps)
                              | set(preempt) | set(ttft) | set(itl)
-                             | set(batch)):
+                             | set(batch) | set(prefill) | set(hits)
+                             | set(ppages) | set(shed) | set(qwait)):
         t = ttft.get(k, {})
         i = itl.get(k, {})
         b = batch.get(k, {})
+        q = qwait.get(k, {})
+        pf = prefill.get(k, 0.0)
+        hit = hits.get(k, 0.0)
         out[joined] = {
             "requests": req.get(k, 0.0),
             "prompt_tokens": ptoks.get(k, 0.0),
@@ -321,6 +332,13 @@ def summarize_llm(samples: List[Sample]) -> Dict[str, Dict[str, float]]:
             "preemptions": preempt.get(k, 0.0),
             "queue_depth": queue.get(k, 0.0),
             "running": running.get(k, 0.0),
+            "prefill_tokens": pf,
+            "prefix_hit_tokens": hit,
+            "prefix_hit_rate": hit / (hit + pf) if (hit + pf) > 0 else 0.0,
+            "prefix_cache_pages": ppages.get(k, 0.0),
+            "shed": shed.get(k, 0.0),
+            "queue_wait_p50_s": q.get("p50", 0.0),
+            "queue_wait_p95_s": q.get("p95", 0.0),
         }
     return out
 

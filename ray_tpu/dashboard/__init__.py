@@ -261,7 +261,8 @@ async function load() {
       html += '<h2>LLM</h2><table><tr><th>engine</th><th>requests</th>' +
         '<th>tokens</th><th>tok/s</th><th>ttft p50 ms</th>' +
         '<th>itl p50 ms</th><th>batch</th><th>kv util</th>' +
-        '<th>preempt</th><th>queue</th><th>tok/s over time</th>' +
+        '<th>preempt</th><th>queue</th><th>prefix hit</th>' +
+        '<th>shed</th><th>tok/s over time</th>' +
         '<th>queue over time</th></tr>';
       for (const [name, d] of lengines.sort()) {
         const series = k => samples.map(s => ((s.llm || {})[name] || {})[k]);
@@ -273,6 +274,8 @@ async function load() {
           `<td>${d.decode_batch_mean.toFixed(1)}</td>` +
           `<td>${bar(d.kv_page_utilization)}</td>` +
           `<td>${d.preemptions}</td><td>${d.queue_depth}</td>` +
+          `<td>${bar(d.prefix_hit_rate || 0)}</td>` +
+          `<td>${d.shed || 0}</td>` +
           `<td>${spark(rate(series('tokens'), ivl), null, '#06c')}</td>` +
           `<td>${spark(series('queue'), null, '#b8860b')}</td></tr>`;
       }

@@ -150,6 +150,31 @@ class WorkerCrashedError(RayError):
     pass
 
 
+class RequestShed(RayError):
+    """A serve request was rejected by admission control (queue full, queue
+    deadline exceeded, or projected time-to-first-token past the deadline).
+    Carries the shed ``reason`` and a ``retry_after_s`` hint the HTTP proxy
+    turns into ``429`` + ``Retry-After`` (or a terminal SSE error event)."""
+
+    def __init__(self, reason: str = "overload", retry_after_s: float = 1.0,
+                 message: str = ""):
+        # tolerate junk args: ``as_instanceof_cause`` hybrids re-enter this
+        # __init__ through the MRO with (function_name, traceback_str) —
+        # the real reason/retry hint live on the pristine ``cause``
+        try:
+            retry_after_s = float(retry_after_s)
+        except (TypeError, ValueError):
+            retry_after_s = 1.0
+        super().__init__(
+            message or f"request shed by admission control ({reason}); "
+                       f"retry after {retry_after_s:.1f}s")
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+
+    def __reduce__(self):
+        return (type(self), (self.reason, self.retry_after_s, str(self)))
+
+
 class CollectiveError(RayError):
     """A collective operation failed (peer death, timeout, shape mismatch)."""
 

@@ -26,6 +26,11 @@ ITL_BOUNDARIES = (
     0.25, 0.5, 1.0, 2.5, 5.0,
 )
 DECODE_BATCH_BOUNDARIES = (1, 2, 4, 8, 16, 32, 64, 128, 256)
+# Admission queue wait: sub-ms fast path through the shed deadline range.
+QUEUE_WAIT_BOUNDARIES = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
 
 _lock = threading.Lock()
 _metrics: Dict[str, M.Metric] = {}
@@ -80,5 +85,26 @@ def llm_metrics() -> Dict[str, M.Metric]:
                         "llm_tokens_per_second",
                         "generation throughput since the first token of "
                         "the current run, per engine"),
+                    "prefix_hit_tokens": M.Counter(
+                        "llm_prefix_cache_hit_tokens_total",
+                        "prompt tokens adopted from the radix prefix cache "
+                        "instead of prefilled, per engine"),
+                    "prefill_tokens": M.Counter(
+                        "llm_prefill_tokens_total",
+                        "prompt tokens actually computed by prefill "
+                        "(prefix-cache misses), per engine"),
+                    "prefix_pages": M.Gauge(
+                        "llm_prefix_cache_pages",
+                        "KV pages currently held by the prefix-cache trie, "
+                        "per engine"),
+                    "shed": M.Counter(
+                        "llm_shed_total",
+                        "requests rejected by admission control, per "
+                        "engine and shed reason"),
+                    "queue_wait": M.Histogram(
+                        "llm_queue_wait_seconds",
+                        "time a request spent in the admission queue "
+                        "before dispatch, per engine",
+                        boundaries=QUEUE_WAIT_BOUNDARIES),
                 }
     return _metrics

@@ -17,6 +17,13 @@ Request body (dict over the handle, JSON over HTTP)::
 
 Streaming responses yield ``{"token": id, "text": piece}`` per token and a
 final ``{"done": true, "request_id": ..., "text": full, ...}`` event.
+
+Admission control sits in front of the engine: every request passes the
+replica's :class:`~ray_tpu.llm.admission.AdmissionController` (bounded
+queue, per-tenant weighted-fair dequeue via ``body["tenant"]``, queue-wait
+deadline, projected-TTFT shed).  Shed requests raise
+:class:`~ray_tpu.exceptions.RequestShed`, which the HTTP proxy renders as
+429 + ``Retry-After`` or a terminal SSE error event.
 """
 
 from __future__ import annotations
@@ -26,6 +33,7 @@ from typing import Any, Dict, Optional, Union
 
 import ray_tpu
 from ray_tpu import serve
+from ray_tpu.llm.admission import AdmissionController
 
 logger = logging.getLogger(__name__)
 
@@ -34,13 +42,18 @@ class LLMServer:
     """The deployment class: thin async facade over one engine actor."""
 
     def __init__(self, engine_kwargs: Optional[dict] = None,
-                 stream_by_default: bool = True):
+                 stream_by_default: bool = True,
+                 admission_kwargs: Optional[dict] = None):
+        from ray_tpu.llm._metrics import llm_metrics
         from ray_tpu.llm.engine import InferenceEngine
 
         kwargs = dict(engine_kwargs or {})
         kwargs.setdefault("engine_name", "serve-llm")
         self._engine = InferenceEngine.options(num_cpus=0).remote(**kwargs)
         self._stream_by_default = stream_by_default
+        self._admission = AdmissionController(**(admission_kwargs or {}))
+        self._metrics = llm_metrics()
+        self._metric_labels = {"engine": kwargs["engine_name"]}
         # block until the engine actor is alive so the replica only reports
         # ready once it can actually serve
         ray_tpu.get(self._engine.ping.remote(), timeout=120)
@@ -77,33 +90,65 @@ class LLMServer:
         if adapter:
             await self.get_adapter(adapter)
             params["adapter"] = adapter
-        rid = await self._engine.submit.remote(prompt, params)
+        tenant = str(body.get("tenant") or "")
+        from ray_tpu.exceptions import RequestShed
+
+        try:
+            wait_s = await self._admission.admit(tenant)
+        except RequestShed as e:
+            self._metrics["shed"].inc(
+                1, {**self._metric_labels, "reason": e.reason})
+            raise
+        self._metrics["queue_wait"].observe(wait_s, self._metric_labels)
+        try:
+            rid = await self._engine.submit.remote(prompt, params)
+        except BaseException:
+            self._admission.release()
+            raise
         stream = body.get("stream", self._stream_by_default)
         if stream:
             return self._token_stream(rid)
-        return await self._drain(rid)
+        try:
+            return await self._drain(rid)
+        finally:
+            self._admission.release()
 
     async def _token_stream(self, rid: str):
         """Async generator: the replica's streaming path drains it into a
         pullable stream; each engine long-poll batch fans out as per-token
-        events."""
+        events.  The finally releases the admission slot and aborts the
+        engine request when the consumer disconnects mid-stream, so
+        partially-prefilled pages are reclaimed."""
         from ray_tpu.llm.engine import decode_tokens
 
         cursor = 0
-        while True:
-            out = await self._engine.next_output.remote(rid, cursor, 20.0)
-            for t in out["tokens"]:
-                yield {"token": int(t), "text": decode_tokens([t])}
-            cursor += len(out["tokens"])
-            if out["finished"]:
-                if out["error"]:
-                    raise RuntimeError(out["error"])
-                result = await self._engine.result.remote(rid)
-                yield {"done": True, "request_id": rid,
-                       "text": result["text"],
-                       "num_tokens": len(result["tokens"]),
-                       "finish_reason": result["finish_reason"]}
-                return
+        finished = False
+        try:
+            while True:
+                out = await self._engine.next_output.remote(rid, cursor,
+                                                            20.0)
+                for t in out["tokens"]:
+                    yield {"token": int(t), "text": decode_tokens([t])}
+                cursor += len(out["tokens"])
+                if out["finished"]:
+                    finished = True
+                    if out["error"]:
+                        raise RuntimeError(out["error"])
+                    result = await self._engine.result.remote(rid)
+                    yield {"done": True, "request_id": rid,
+                           "text": result["text"],
+                           "num_tokens": len(result["tokens"]),
+                           "finish_reason": result["finish_reason"]}
+                    return
+        finally:
+            self._admission.release()
+            if not finished:
+                # fire-and-forget: no awaits are legal while the generator
+                # is being torn down by a cancellation
+                try:
+                    self._engine.abort.remote(rid)
+                except Exception:
+                    pass
 
     async def _drain(self, rid: str) -> Dict[str, Any]:
         cursor = 0
@@ -118,16 +163,20 @@ class LLMServer:
     # ----------------------------------------------------------- plumbing
     def __serve_queue_len__(self) -> int:
         """Queue-depth signal for the serve autoscaler: requests parked in
-        the engine behind the currently-running batch (the replica adds
-        this to its in-flight count in ``stats()``)."""
+        the replica's admission queue plus those in the engine behind the
+        currently-running batch (the replica adds this to its in-flight
+        count in ``stats()``)."""
+        backlog = self._admission.queued
         try:
             st = ray_tpu.get(self._engine.stats.remote(), timeout=2)
-            return int(st["waiting"] + st["running"])
+            return backlog + int(st["waiting"] + st["running"])
         except Exception:
-            return 0
+            return backlog
 
     def engine_stats(self) -> Dict[str, Any]:
-        return ray_tpu.get(self._engine.stats.remote(), timeout=10)
+        stats = ray_tpu.get(self._engine.stats.remote(), timeout=10)
+        stats["admission"] = self._admission.stats()
+        return stats
 
     def check_health(self) -> None:
         ray_tpu.get(self._engine.ping.remote(), timeout=5)
@@ -137,16 +186,23 @@ def llm_deployment(engine_kwargs: Optional[dict] = None, *,
                    name: str = "LLM", num_replicas: int = 1,
                    max_ongoing_requests: int = 64,
                    autoscaling_config=None,
-                   stream_by_default: bool = True) -> "serve.Application":
+                   stream_by_default: bool = True,
+                   admission_kwargs: Optional[dict] = None
+                   ) -> "serve.Application":
     """Build a Serve Application serving an LLM engine fleet::
 
         app = llm_deployment(engine_kwargs={"num_pages": 64})
         handle = serve.run(app, name="llm", route_prefix="/llm")
         stream = handle.remote({"prompt_ids": [1, 2, 3]}).result(60)
         for event in stream: ...
+
+    ``admission_kwargs`` configures each replica's admission controller
+    (``max_inflight``, ``max_queue``, ``queue_deadline_s``,
+    ``tenant_weights``); the defaults are generous enough to be
+    transparent below saturation.
     """
     dep = serve.deployment(
         LLMServer, name=name, num_replicas=num_replicas,
         max_ongoing_requests=max_ongoing_requests,
         autoscaling_config=autoscaling_config)
-    return dep.bind(engine_kwargs, stream_by_default)
+    return dep.bind(engine_kwargs, stream_by_default, admission_kwargs)

@@ -71,6 +71,8 @@ class EngineCore:
                  max_batch_tokens: int = 128, max_running: int = 64,
                  cache_backend: str = "numpy", init_from_flax: bool = False,
                  step_delay_s: float = 0.0,
+                 prefill_chunk_tokens: int = 0,
+                 enable_prefix_cache: bool = False,
                  runner: Optional[GPT2Runner] = None):
         self.name = engine_name
         self.config = model_config if model_config is not None \
@@ -86,10 +88,12 @@ class EngineCore:
             num_heads=self.config.n_head,
             head_dim=self.config.n_embd // self.config.n_head,
             num_pages=num_pages, page_size=page_size,
-            backend=cache_backend))
+            backend=cache_backend,
+            enable_prefix_cache=enable_prefix_cache))
         self.scheduler = Scheduler(self.cache,
                                    max_batch_tokens=max_batch_tokens,
-                                   max_running=max_running)
+                                   max_running=max_running,
+                                   prefill_chunk_tokens=prefill_chunk_tokens)
         # artificial per-step floor: simulates a heavier model so tests can
         # hold a batch under load long enough to observe overlap/preemption
         self.step_delay_s = step_delay_s
@@ -107,6 +111,10 @@ class EngineCore:
         self.total_generated = 0
         self._first_token_wall: Optional[float] = None
         self._last_token_wall: Optional[float] = None
+        # counter high-water marks already pushed to metrics (counters take
+        # increments; the scheduler/cache keep running totals)
+        self._prefix_hits_pushed = 0
+        self._prefilled_pushed = 0
 
     # -------------------------------------------------------------- intake
     def submit(self, prompt: Union[str, Sequence[int]],
@@ -210,11 +218,25 @@ class EngineCore:
         for req, tokens, start in plan.prefills:
             logits = self.runner.prefill(req.rid, tokens, start, self.cache)
             req.num_computed = start + len(tokens)
-            self._emit(req, self._sample(req, logits))
+            if self.cache.config.enable_prefix_cache:
+                # index the now-committed full prompt pages so later
+                # requests sharing this prefix can adopt them
+                self.cache.insert_prefix(
+                    req.rid,
+                    req.prompt[:min(req.num_computed, len(req.prompt))])
+            if req.num_computed == req.total_len:
+                # chunk reached the end of the sequence: the last
+                # position's logits produce the next token.  Intermediate
+                # chunks of a long prompt just advance num_computed.
+                self._emit(req, self._sample(req, logits))
         if plan.decodes:
-            items = [(r.rid, r.outputs[-1], r.total_len - 1)
+            # all_tokens[-1] (not outputs[-1]): after a chunked prefill
+            # stopping one short of the prompt end, the "decode" that
+            # produces the first output token feeds the final prompt token
+            items = [(r.rid, r.all_tokens[-1], r.total_len - 1)
                      for r in plan.decodes]
-            logits = self.runner.decode(items, self.cache)
+            drafts = self.runner.propose_tokens(items, self.cache)
+            logits = self.runner.verify_tokens(items, drafts, self.cache)
             with self._lock:
                 self._metrics["decode_batch"].observe(len(items),
                                                       self._labels)
@@ -236,6 +258,18 @@ class EngineCore:
                                          self._labels)
         self._metrics["running"].set(self.scheduler.num_running,
                                      self._labels)
+        self._metrics["prefix_pages"].set(self.cache.trie_pages,
+                                          self._labels)
+        hits = self.scheduler.prefix_hit_tokens
+        if hits > self._prefix_hits_pushed:
+            self._metrics["prefix_hit_tokens"].inc(
+                hits - self._prefix_hits_pushed, self._labels)
+            self._prefix_hits_pushed = hits
+        filled = self.scheduler.prefilled_tokens
+        if filled > self._prefilled_pushed:
+            self._metrics["prefill_tokens"].inc(
+                filled - self._prefilled_pushed, self._labels)
+            self._prefilled_pushed = filled
         if self._first_token_wall is not None \
                 and self._last_token_wall is not None:
             span = self._last_token_wall - self._first_token_wall
@@ -278,8 +312,9 @@ class EngineCore:
                 self._metrics["ttft"].observe(now - req.submitted_at,
                                               self._labels)
             elif req.last_token_at is not None:
-                self._metrics["itl"].observe(now - req.last_token_at,
-                                             self._labels)
+                gap = now - req.last_token_at
+                req.max_itl = max(req.max_itl, gap)
+                self._metrics["itl"].observe(gap, self._labels)
             req.last_token_at = now
             self._metrics["tokens"].inc(1, self._labels)
             if len(req.outputs) >= req.params.max_tokens:
@@ -326,6 +361,9 @@ class EngineCore:
                 "finish_reason": req.finish_reason,
                 "error": req.error,
                 "preemptions": req.preemptions,
+                "ttft": (req.first_token_at - req.submitted_at
+                         if req.first_token_at is not None else None),
+                "max_itl": req.max_itl,
             }
 
     def has_work(self) -> bool:
@@ -373,6 +411,9 @@ class EngineCore:
                 "kv_pages_free": self.cache.free_pages,
                 "kv_page_utilization": self.cache.utilization(),
                 "kv_peak_pages_used": self.cache.peak_pages_used,
+                "prefilled_tokens": self.scheduler.prefilled_tokens,
+                "prefix_hit_tokens": self.scheduler.prefix_hit_tokens,
+                "prefix_cache_pages": self.cache.trie_pages,
                 "adapters": sorted(self._adapters),
             }
 

@@ -209,6 +209,25 @@ class GPT2Runner:
         logits = x @ self.lm_head
         return logits if return_all else logits[-1]
 
+    # ------------------------------------------------- speculative hooks
+    def propose_tokens(self, items: Sequence[Tuple[str, int, int]],
+                       cache: PagedKVCache,
+                       max_draft: int = 0) -> List[List[int]]:
+        """Speculative-decoding hook: propose up to ``max_draft`` draft
+        tokens per sequence (``items`` as in :meth:`decode`).  The base
+        runner has no draft model and proposes nothing; a future draft
+        runner overrides this without any scheduler changes."""
+        return [[] for _ in items]
+
+    def verify_tokens(self, items: Sequence[Tuple[str, int, int]],
+                      drafts: Sequence[List[int]],
+                      cache: PagedKVCache) -> np.ndarray:
+        """Verify drafted tokens against the target model.  The default
+        single-token implementation ignores ``drafts`` and runs one plain
+        decode step, so the engine's decode path can route through
+        propose/verify unconditionally."""
+        return self.decode(items, cache)
+
     def decode(self, items: Sequence[Tuple[str, int, int]],
                cache: PagedKVCache) -> np.ndarray:
         """One continuous-batching decode step.  ``items`` is a list of

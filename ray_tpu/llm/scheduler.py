@@ -13,6 +13,20 @@ Structuring prefill and decode as distinct stages that one step can mix
 follows the MPMD-stage decomposition (arXiv 2412.14374); the scheduler is
 deliberately free of model math so the engine can later pin the two stages
 to different meshes.
+
+Two serving fast paths layer on top of the same plan loop:
+
+* **Chunked prefill** (``prefill_chunk_tokens > 0``): a long prompt is fed
+  through prefill in chunks of at most that many tokens, one chunk per
+  step, interleaved with running decodes — decodes are planned first so a
+  10k-token prompt costs each in-flight stream one chunk of extra latency
+  per token instead of one full prefill.  A mid-prefill request is RUNNING
+  with ``num_computed < total_len - 1``; the plan's continuation pass
+  advances it before any new admission.
+* **Prefix caching** (cache built with ``enable_prefix_cache=True``): on
+  admission the scheduler forks the request's page table from the longest
+  trie match (``fork_from_prefix``) and starts prefill at the match point,
+  so a shared system prompt is computed once, not per request.
 """
 
 from __future__ import annotations
@@ -70,6 +84,7 @@ class Request:
         self.submitted_at = time.perf_counter()
         self.first_token_at: Optional[float] = None
         self.last_token_at: Optional[float] = None
+        self.max_itl = 0.0  # widest inter-token gap observed (bench reads)
         self.finish_reason: Optional[str] = None
         self.error: Optional[str] = None
         self.preemptions = 0
@@ -108,15 +123,23 @@ class StepPlan:
 
 class Scheduler:
     def __init__(self, cache: PagedKVCache, *,
-                 max_batch_tokens: int = 128, max_running: int = 64):
+                 max_batch_tokens: int = 128, max_running: int = 64,
+                 prefill_chunk_tokens: int = 0):
         if max_batch_tokens < 1:
             raise ValueError("max_batch_tokens must be >= 1")
+        if prefill_chunk_tokens < 0:
+            raise ValueError("prefill_chunk_tokens must be >= 0")
         self.cache = cache
         self.max_batch_tokens = max_batch_tokens
         self.max_running = max_running
+        # 0 disables chunking: a prompt prefills whole, and strict FCFS
+        # blocks admission while the head doesn't fit the step budget
+        self.prefill_chunk_tokens = prefill_chunk_tokens
         self.waiting: List[Request] = []   # kept sorted by arrival (FCFS)
         self.running: List[Request] = []   # kept in arrival order
         self.preemptions = 0
+        self.prefilled_tokens = 0   # prompt tokens actually sent to prefill
+        self.prefix_hit_tokens = 0  # tokens adopted from the prefix cache
 
     # ------------------------------------------------------------ intake
     def add(self, req: Request) -> None:
@@ -144,15 +167,19 @@ class Scheduler:
     # -------------------------------------------------------------- plan
     def plan(self) -> StepPlan:
         """Build one iteration: decode every running sequence (preempting
-        newest-first on page exhaustion), then admit waiting requests FCFS
-        into the leftover token budget."""
+        newest-first on page exhaustion), continue any in-flight chunked
+        prefills, then admit waiting requests FCFS into the leftover token
+        budget (adopting cached prefix pages first when prefix caching is
+        on)."""
         out = StepPlan()
         budget = self.max_batch_tokens
 
-        # 1. decode pass — arrival order so older requests keep priority
+        # 1. decode pass — arrival order so older requests keep priority;
+        # scheduled first so a long prefill never stalls in-flight ITL
         for req in list(self.running):
-            if req.state is not RUNNING:
-                continue  # preempted by an earlier iteration of this loop
+            if req.state is not RUNNING \
+                    or req.total_len - req.num_computed != 1:
+                continue  # preempted earlier this loop, or mid-prefill
             if budget <= 0:
                 break
             # a decode step writes K/V at position total_len-1, growing the
@@ -161,14 +188,33 @@ class Scheduler:
                 out.decodes.append(req)
                 budget -= 1
 
-        # 2. FCFS admission between decode steps
+        # 2. prefill continuations — RUNNING requests mid chunked prefill
+        for req in list(self.running):
+            if req.state is not RUNNING:
+                continue
+            remaining = req.total_len - req.num_computed
+            if remaining <= 1:
+                continue  # decoding (handled above)
+            if budget <= 0:
+                break
+            chunk = self._chunk_len(remaining, budget)
+            end = req.num_computed + chunk
+            if self._reserve_with_preemption(req, end, out):
+                out.prefills.append(
+                    (req, req.all_tokens[req.num_computed:end],
+                     req.num_computed))
+                self.prefilled_tokens += chunk
+                budget -= chunk
+
+        # 3. FCFS admission between decode steps
         while self.waiting and budget > 0 \
                 and len(self.running) < self.max_running:
             req = self.waiting[0]
-            tokens = req.all_tokens[req.num_computed:]
-            if len(tokens) > budget:
+            remaining = req.total_len - req.num_computed
+            if self.prefill_chunk_tokens <= 0 and remaining > budget:
                 # head-of-line stays (strict FCFS): a later shorter request
-                # must not starve it
+                # must not starve it; with chunking on, the head admits a
+                # chunk instead of blocking
                 break
             need_total = self.cache.pages_for(req.total_len + 1)
             if need_total > self.cache.num_pages:
@@ -176,9 +222,24 @@ class Scheduler:
                            f"request needs {need_total} pages; cache has "
                            f"{self.cache.num_pages}")
                 continue
+            adopted = 0
+            if self.cache.config.enable_prefix_cache \
+                    and not self.cache.has_seq(req.rid):
+                adopted = self.cache.fork_from_prefix(
+                    req.rid, req.all_tokens)
+                if adopted:
+                    req.num_computed = adopted
+                    remaining = req.total_len - adopted
+            chunk = self._chunk_len(remaining, budget)
+            end = req.num_computed + chunk
             try:
-                self.cache.reserve(req.rid, req.total_len)
+                self.cache.reserve(req.rid, end)
             except CacheExhausted:
+                if adopted:
+                    # don't hold adopted pages while parked in waiting;
+                    # the trie keeps them cached for the retry
+                    self.cache.free(req.rid)
+                    req.num_computed = 0
                 if self.cache.used_pages == 0 and not self.running:
                     # whole cache is free and it still doesn't fit — it
                     # never will
@@ -189,9 +250,19 @@ class Scheduler:
             self.waiting.pop(0)
             req.state = RUNNING
             self.running.append(req)
-            out.prefills.append((req, tokens, req.num_computed))
-            budget -= len(tokens)
+            out.prefills.append(
+                (req, req.all_tokens[req.num_computed:end],
+                 req.num_computed))
+            self.prefilled_tokens += chunk
+            self.prefix_hit_tokens += adopted
+            budget -= chunk
         return out
+
+    def _chunk_len(self, remaining: int, budget: int) -> int:
+        chunk = min(remaining, budget)
+        if self.prefill_chunk_tokens > 0:
+            chunk = min(chunk, self.prefill_chunk_tokens)
+        return chunk
 
     def _fail(self, req: Request, out: StepPlan, reason: str) -> None:
         self.waiting.remove(req)
@@ -222,7 +293,9 @@ class Scheduler:
     def _preempt(self, req: Request, out: StepPlan) -> None:
         """Evict: free pages, requeue for recompute-on-resume.  The request
         keeps its generated tokens; on re-admission the prefill covers
-        prompt + outputs so the resumed state is bit-identical."""
+        prompt + outputs so the resumed state is bit-identical.  Any work
+        already planned for the victim this step is scrubbed — its pages
+        are gone."""
         self.cache.free(req.rid)
         self.running.remove(req)
         req.num_computed = 0
@@ -231,6 +304,8 @@ class Scheduler:
         self.preemptions += 1
         self.add(req)
         out.preempted.append(req)
+        out.decodes[:] = [r for r in out.decodes if r is not req]
+        out.prefills[:] = [p for p in out.prefills if p[0] is not req]
 
     # --------------------------------------------------------- completion
     def finish(self, req: Request, reason: str) -> None:

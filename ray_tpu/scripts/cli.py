@@ -242,14 +242,17 @@ def _print_llm_summary(summary: dict) -> None:
         return
     print(f"{'engine':24} {'reqs':>6} {'tokens':>8} {'tok/s':>8} "
           f"{'ttft p50 ms':>12} {'ttft p95 ms':>12} {'itl p50 ms':>11} "
-          f"{'batch':>6} {'kv%':>5} {'preempt':>8} {'queue':>6}")
+          f"{'batch':>6} {'kv%':>5} {'preempt':>8} {'queue':>6} "
+          f"{'hit%':>5} {'shed':>5}")
     for name, d in sorted(summary.items()):
         print(f"{name:24} {d['requests']:>6g} {d['generated_tokens']:>8g} "
               f"{d['tokens_per_second']:>8.1f} "
               f"{d['ttft_p50_s']*1e3:>12.3f} {d['ttft_p95_s']*1e3:>12.3f} "
               f"{d['itl_p50_s']*1e3:>11.3f} {d['decode_batch_mean']:>6.1f} "
               f"{d['kv_page_utilization']*100:>5.1f} "
-              f"{d['preemptions']:>8g} {d['queue_depth']:>6g}")
+              f"{d['preemptions']:>8g} {d['queue_depth']:>6g} "
+              f"{d.get('prefix_hit_rate', 0.0)*100:>5.1f} "
+              f"{d.get('shed', 0.0):>5g}")
 
 
 def _print_hangs_summary(hangs: list) -> None:

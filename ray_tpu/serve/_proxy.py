@@ -27,6 +27,20 @@ import ray_tpu
 logger = logging.getLogger(__name__)
 
 
+def _shed_cause(e: BaseException):
+    """Unwrap admission-control sheds: the deployment raises RequestShed,
+    which crosses the replica boundary either as an instance-of-cause
+    hybrid or as a RayTaskError carrying it in ``cause``."""
+    from ray_tpu.exceptions import RequestShed
+
+    # prefer the pristine cause: an as_instanceof_cause hybrid IS a
+    # RequestShed but carries task-wrapper args, not the shed's
+    cause = getattr(e, "cause", None)
+    if isinstance(cause, RequestShed):
+        return cause
+    return e if isinstance(e, RequestShed) else None
+
+
 @ray_tpu.remote(num_cpus=0)
 class ProxyActor:
     def __init__(self, host: str, port: int, grpc_port: Optional[int] = None):
@@ -124,6 +138,11 @@ class ProxyActor:
             await context.abort(grpc.StatusCode.NOT_FOUND,
                                 f"no application {app_name!r}")
         except Exception as e:
+            shed = _shed_cause(e)
+            if shed is not None:
+                self._observe_ingress("grpc", "resource_exhausted", start)
+                await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                                    str(shed))
             self._observe_ingress("grpc", "error", start)
             await context.abort(grpc.StatusCode.INTERNAL,
                                 f"{type(e).__name__}: {e}")
@@ -165,6 +184,9 @@ class ProxyActor:
             self._observe_ingress("http", "404", start)
             return web.Response(status=404, text="no route")
         except Exception as e:
+            shed = _shed_cause(e)
+            if shed is not None:
+                return self._shed_response(request, shed, start)
             self._observe_ingress("http", "500", start)
             return web.Response(status=500, text=f"{type(e).__name__}: {e}")
         from ray_tpu.serve._streaming import ResponseStream
@@ -179,6 +201,26 @@ class ProxyActor:
         if isinstance(out, bytes):
             return web.Response(body=out)
         return web.Response(text=str(out))
+
+    def _shed_response(self, request, shed, start):
+        """Admission-control shed: 429 + ``Retry-After``, never a hang.
+        Clients that asked for SSE get the refusal as a terminal
+        ``event: error`` frame (same shape streams use for mid-stream
+        failures) so one parser handles both."""
+        from aiohttp import web
+
+        self._observe_ingress("http", "429", start)
+        retry_after = max(1, int(-(-shed.retry_after_s // 1)))  # ceil
+        payload = {"error": "shed", "reason": shed.reason,
+                   "retry_after_s": shed.retry_after_s}
+        headers = {"Retry-After": str(retry_after)}
+        accept = request.headers.get("Accept", "")
+        if "text/event-stream" in accept:
+            body = (b"event: error\ndata: " + json.dumps(payload).encode()
+                    + b"\n\n")
+            return web.Response(status=429, headers=headers, body=body,
+                                content_type="text/event-stream")
+        return web.json_response(payload, status=429, headers=headers)
 
     async def _stream_response(self, request, stream, start, retry=None):
         """Generator-returning deployment over HTTP: chunked SSE — each

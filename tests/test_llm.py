@@ -156,6 +156,19 @@ def test_runner_matches_flax_model():
 
 # ============================================================ scheduler
 
+def _apply_plan(plan):
+    """Simulate the engine's side of the contract: a planned prefill
+    advances num_computed; a completed prefill or decode samples one
+    token."""
+    for r, toks, start in plan.prefills:
+        r.num_computed = start + len(toks)
+        if r.num_computed == r.total_len:
+            r.outputs.append(9)
+    for r in plan.decodes:
+        r.num_computed += 1
+        r.outputs.append(9)
+
+
 def test_scheduler_fcfs_admission_and_token_budget():
     cache = _cache(num_pages=64, page_size=4)
     sched = Scheduler(cache, max_batch_tokens=10)
@@ -168,10 +181,12 @@ def test_scheduler_fcfs_admission_and_token_budget():
     # a fits (6 <= 10); b would exceed the leftover budget (4) and, being
     # head of line, blocks c (strict FCFS — no skipping)
     assert [r.rid for r, _, _ in plan.prefills] == ["a"]
+    _apply_plan(plan)
     plan = sched.plan()
     # next step: a decodes (1 token), b prefills into the remaining budget
     assert [r.rid for r in plan.decodes] == ["a"]
     assert [r.rid for r, _, _ in plan.prefills] == ["b"]
+    _apply_plan(plan)
     plan = sched.plan()
     assert [r.rid for r in plan.decodes] == ["a", "b"]
     assert [r.rid for r, _, _ in plan.prefills] == ["c"]
