@@ -535,6 +535,45 @@ def main() -> None:
         except Exception as e:
             result["pipeline_error"] = repr(e)
 
+    # 3D-parallel train sweep (ARCHITECTURE §4d): (dp, tp, pp) in
+    # {(2,1,1), (1,1,2), (2,1,2)} on tiny-GPT-2, recording step wall,
+    # comm-bucket seconds, dp wire bytes and measured overlap fraction per
+    # config, plus the fp32 -> int8 wire ratio on the (2,1,1) dp exchange.
+    # Subprocess for the same 1-device CPU isolation as the pipeline rows.
+    if os.environ.get("RAY_TPU_BENCH_TRAIN3D", "1") != "0":
+        import subprocess
+        import sys
+
+        code = ("import json; from ray_tpu._private.pipeline_bench "
+                "import run_train_3d_bench; "
+                "print('TRAIN3D=' + json.dumps(run_train_3d_bench()))")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.update(rig_env)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        try:
+            proc = subprocess.Popen([sys.executable, "-c", code],
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True,
+                                    env=env, start_new_session=True)
+            try:
+                stdout, stderr = proc.communicate(timeout=420)
+            except subprocess.TimeoutExpired:
+                import signal
+
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait()
+                raise
+            for line in stdout.splitlines():
+                if line.startswith("TRAIN3D="):
+                    result["train_3d"] = json.loads(
+                        line[len("TRAIN3D="):])
+                    break
+            else:
+                result["train_3d_error"] = (stderr or "no output")[-500:]
+        except Exception as e:
+            result["train_3d_error"] = repr(e)
+
     # Serving-at-scale rows (ISSUE 13): prefix-cache prefill reduction,
     # chunked-prefill ITL A/B, and the SSE load harness (hundreds of
     # concurrent streams against a 2-replica deployment through the real
@@ -590,7 +629,7 @@ def main() -> None:
     # Stamp the topology into every sub-bench row: a BENCH_*.json diff must
     # never compare a pinned 8-core number against an unpinned 1-core one
     # without seeing the difference in the row itself.
-    for key in ("micro", "collective", "recovery", "pipeline",
+    for key in ("micro", "collective", "recovery", "pipeline", "train_3d",
                 "llm_decode_throughput", "watchdog_overhead", "lint_tree",
                 "serve_load"):
         if isinstance(result.get(key), dict):

@@ -17,7 +17,10 @@ Two sub-rules:
   an op token (``quorum_allreduce``, ``hier_broadcast``,
   ``allreduce_int8``, ...) is a collective entry point and must be
   bounded; private ``_``-prefixed helpers inherit their caller's deadline
-  and are exempt.  (The XLA backend's in-device collectives run inside jit
+  and are exempt.  ``wait`` is an op token too: the async-handle surface
+  (``handle.wait``, ``wait_all``, the bucketed grad-exchange barriers) is
+  where a lost completion parks the caller, so every ``*wait*`` entry
+  point must be bounded the same way the blocking ops are.  (The XLA backend's in-device collectives run inside jit
   where wall-clock timeouts are not expressible — that file carries a
   documented ``lint: disable-file`` and is covered by the hang watchdog
   instead.)
@@ -49,7 +52,7 @@ from typing import Dict, Iterable, List, Set
 from ray_tpu._lint.core import Checker, FileCtx, Finding, register
 
 COLLECTIVE_OPS = {"allreduce", "allgather", "reducescatter", "broadcast",
-                  "barrier", "send", "recv"}
+                  "barrier", "send", "recv", "wait"}
 _COLLECTIVE_MODULE = "ray_tpu.util.collective"
 
 # stage-wait tokens inside train/pipeline/: link frame ops, rendezvous

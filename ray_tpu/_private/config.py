@@ -239,6 +239,25 @@ _d("collective_virtual_nodes", int, 0,
    "hierarchical topology (>0 overrides real node placement, so a "
    "single-host world can exercise the two-level path)")
 
+# --- Train: 3D-parallel dp gradient exchange (train/pipeline/dp_sync.py;
+# --- env re-read at DpGradSync construction so tests/benches can retune a
+# --- trainer mid-process, but declared here for dump/propagation)
+_d("train_grad_bucket_bytes", int, 4 * 1024 * 1024,
+   "size cap (fp32 bytes) for gradient allreduce buckets in dp-composed "
+   "pipeline training; grads flush into buckets the moment the last "
+   "backward microbatch completes so the allreduce overlaps the "
+   "remaining 1F1B drain.  <= 0 = one bucket per parameter leaf")
+_d("train_grad_quant", str, "",
+   "wire quantization for the dp gradient allreduce ('' = fp32 exact, "
+   "'int8' = block-scaled int8: ~4x fewer wire bytes at a bounded "
+   "per-element error; see ARCHITECTURE §4d parity band)")
+_d("train_dp_quorum", int, 0,
+   "straggler quorum K for the dp gradient allreduce: each bucket "
+   "completes once K of dp replicas contribute, late contributions fold "
+   "into the next step (sum/mean semantics preserved cumulatively); "
+   "0 = full participation.  The stage-0 commit-frame scalar allreduce "
+   "always runs full-participation so clip/loss stay replica-consistent")
+
 # --- Bench rig (_private/bench_rig.py; read via os.environ each call so
 # --- benches can toggle mid-process, but declared here for dump/propagation)
 _d("bench_rig", bool, True,

@@ -191,3 +191,106 @@ def run_pipeline_bench() -> dict:
         ex_a.close()
         ex_b.close()
     return out
+
+
+# --------------------------------------------------- 3D composition sweep
+
+TRAIN3D_CONFIGS = ((2, 1, 1), (1, 1, 2), (2, 1, 2))
+TRAIN3D_STEPS = 4  # first step is compile warmup, excluded from the rows
+TRAIN3D_MICRO = 4
+
+
+def _run_3d_config(cfg, dp: int, pp: int, n_micro: int, steps: int,
+                   quant=None) -> dict:
+    """One (dp, tp=1, pp) cell grid on the thread-gang harness: one
+    StageExecutor per (replica, stage), LocalReplicaGroup per stage for
+    the dp exchange (ring-modeled wire bytes), direct ShmChannel links per
+    replica for the 1F1B frames.  Returns the §4d quartet aggregated over
+    the post-warmup steps."""
+    import threading
+
+    import jax
+
+    from ray_tpu.train.pipeline import (
+        DpGradSync, GPT2StageModule, LocalReplicaGroup, StageExecutor,
+        pipeline_mesh)
+
+    mesh = pipeline_mesh(devices=jax.devices()[:1])
+    groups = [LocalReplicaGroup(dp) for _ in range(pp)]
+    execs, syncs = {}, {}
+    for r in range(dp):
+        links = _direct_links() if pp == 2 else ({},)
+        for st in range(pp):
+            sync = None
+            if dp > 1:
+                sync = DpGradSync(groups[st].member(r), quant=quant,
+                                  timeout_s=120.0)
+                syncs[(r, st)] = sync
+            execs[(r, st)] = StageExecutor(
+                GPT2StageModule(cfg, st, pp), mesh, n_micro=n_micro,
+                links=links[st], lr=1e-3, total_steps=1000,
+                dp_sync=sync, replica=r)
+    outs = {c: [] for c in execs}
+    errs: List[BaseException] = []
+    half = BATCH // dp
+
+    def _drive(r, st):
+        try:
+            for s in range(steps):
+                b = _batch(cfg, s)
+                if dp > 1:
+                    b = {k: v[r * half:(r + 1) * half] for k, v in b.items()}
+                outs[(r, st)].append(execs[(r, st)].train_step(b))
+        except BaseException as e:
+            errs.append(e)
+
+    cells = sorted(execs)
+    threads = [threading.Thread(target=_drive, args=c) for c in cells[1:]]
+    for t in threads:
+        t.start()
+    _drive(*cells[0])
+    for t in threads:
+        t.join(300)
+    if errs:
+        raise errs[0]
+    for ex in execs.values():
+        ex.close()
+    timed = outs[(0, 0)][1:]  # drop the compile-warmup step
+    n = len(timed)
+    row = {
+        "dp": dp, "tp": 1, "pp": pp,
+        "step_wall_s": round(sum(o["step_wall_s"] for o in timed) / n, 4),
+        "comm_bucket_s": round(sum(o["comm_s"] for o in timed) / n, 4),
+        "overlap_fraction": round(
+            sum(o["overlap_fraction"] for o in timed) / n, 4),
+        # every replica's stage-0 + stage-k exchange, all steps incl warmup
+        "wire_bytes": int(sum(s.total_wire_bytes for s in syncs.values())),
+    }
+    return row
+
+
+def run_train_3d_bench() -> dict:
+    """(dp, tp, pp) sweep of ARCHITECTURE §4d on tiny-GPT-2: per config
+    the step wall clock, the BubbleClock comm-bucket seconds, the dp wire
+    bytes and the measured overlap fraction — plus the fp32 -> int8 wire
+    ratio on the (2, 1, 1) dp exchange (must stay >= 3x; the quantized
+    record ships 1 byte + 4/block scale bytes per fp32 element)."""
+    cfg = _tiny_cfg()
+    out: dict = {
+        "steps_timed": TRAIN3D_STEPS - 1, "n_micro": TRAIN3D_MICRO,
+        "batch": BATCH, "seq": SEQ, "host_cpus": os.cpu_count(),
+        "configs": [],
+    }
+    fp32_wire = None
+    for dp, _tp, pp in TRAIN3D_CONFIGS:
+        row = _run_3d_config(cfg, dp, pp, TRAIN3D_MICRO, TRAIN3D_STEPS)
+        if (dp, pp) == (2, 1):
+            fp32_wire = row["wire_bytes"]
+        out["configs"].append(row)
+    int8 = _run_3d_config(cfg, 2, 1, TRAIN3D_MICRO, TRAIN3D_STEPS,
+                          quant="int8")
+    int8["quant"] = "int8"
+    out["configs"].append(int8)
+    if fp32_wire and int8["wire_bytes"]:
+        out["int8_wire_ratio"] = round(fp32_wire / int8["wire_bytes"], 3)
+    return out

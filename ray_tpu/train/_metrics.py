@@ -78,5 +78,23 @@ def train_metrics() -> Dict[str, M.Metric]:
                         "compute (fwd+bwd+optim) seconds of the last step "
                         "on this stage — the overlap-accounting numerator, "
                         "per experiment and stage"),
+                    "pipeline_comm": M.Counter(
+                        "pipeline_comm_seconds",
+                        "seconds a pipeline stage spent on the dp gradient "
+                        "collective (bucket packing/launch + blocked at "
+                        "the clip barrier), per experiment and stage — "
+                        "split out of the wait bucket so bubble keeps "
+                        "meaning schedule stall"),
+                    "pipeline_overlap_fraction": M.Gauge(
+                        "pipeline_overlap_fraction",
+                        "share of the last step's dp-collective execution "
+                        "time hidden behind 1F1B compute (1 - blocked/"
+                        "comm-op seconds; 0 when no dp comm), per "
+                        "experiment and stage"),
+                    "train_dp_wire_bytes": M.Counter(
+                        "train_dp_wire_bytes",
+                        "wire bytes this stage's replica shipped for the "
+                        "dp gradient exchange (bucket allreduces + commit "
+                        "scalar), per experiment and stage"),
                 }
     return _metrics

@@ -71,6 +71,12 @@ class JaxConfig(BackendConfig):
     # stages exchange channel frames, never XLA collectives, so a gang of 1
     # skips jax.distributed entirely (local devices only).
     pipeline_stages: int = 1
+    # 3D composition (set by JaxTrainer(mesh=(dp, tp))): the worker group
+    # factors replica-major into dp_replicas × pipeline_stages gangs.  The
+    # dp gradient exchange rides the host collective stack (KV rendezvous
+    # per stage), never jax.distributed — replicas are independent jax
+    # worlds just like stages.
+    dp_replicas: int = 1
 
     @property
     def backend_cls(self):
@@ -141,13 +147,17 @@ class _JaxBackend(Backend):
 
         n = len(worker_group)
         stages = max(1, backend_config.pipeline_stages)
-        if n % stages:
+        dp = max(1, backend_config.dp_replicas)
+        # replica-major factoring: dp*stages independent jax worlds, each a
+        # contiguous rank block of `gang` processes
+        worlds = stages * dp
+        if n % worlds:
             raise RuntimeError(
-                f"worker group of {n} not divisible by pipeline_stages "
-                f"{stages}")
-        gang = n // stages
+                f"worker group of {n} not divisible by dp_replicas * "
+                f"pipeline_stages = {dp} * {stages}")
+        gang = n // worlds
         refs = []
-        for s in range(stages):
+        for s in range(worlds):
             lo = s * gang
             if gang == 1:
                 coordinator = None  # one-process gang: no jax.distributed
@@ -162,15 +172,16 @@ class _JaxBackend(Backend):
                     backend_config.platform,
                     backend_config.cpu_devices_per_worker))
         infos = ray_tpu.get(refs, timeout=120.0)
-        # device counts must agree WITHIN each stage gang (gangs are
-        # independent jax worlds and may differ across stages)
-        for s in range(stages):
+        # device counts must agree WITHIN each gang (gangs are independent
+        # jax worlds and may differ across stages/replicas)
+        for s in range(worlds):
             counts = {i["global_device_count"]
                       for i in infos[s * gang:(s + 1) * gang]}
             if len(counts) != 1:
                 raise RuntimeError(
-                    f"jax.distributed came up inconsistent across stage "
-                    f"{s}'s gang: {infos[s * gang:(s + 1) * gang]}")
+                    f"jax.distributed came up inconsistent across gang "
+                    f"{s} (replica-major order): "
+                    f"{infos[s * gang:(s + 1) * gang]}")
         self.device_info = infos[0]
 
     def on_shutdown(self, worker_group: WorkerGroup,

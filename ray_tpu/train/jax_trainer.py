@@ -44,7 +44,16 @@ class JaxTrainer(DataParallelTrainer):
     cross-stage jax.distributed — stages talk through channel frames, not
     XLA collectives), and the train loop sees ``_pipeline`` =
     ``{"n_stages": N, "n_micro": M}`` in its config.  ``num_microbatches``
-    is the gradient-accumulation width of the 1F1B schedule."""
+    is the gradient-accumulation width of the 1F1B schedule.
+
+    ``mesh=(dp, tp)`` composes the third axis (ARCHITECTURE §4d): the gang
+    factors replica-major into ``dp`` data-parallel replicas × ``N`` stage
+    gangs, each stage sharding over ``tp`` of its worker's local devices.
+    Replicas train on disjoint slices of the global batch; each stage's
+    cross-replica gradient allreduce rides the host collective stack
+    (bucketed + overlapped with the 1F1B drain; optionally int8-quantized
+    or quorum'd via the ``train_grad_*`` flags).  ``num_workers`` must
+    equal ``dp * pipeline_stages``."""
 
     _default_backend_config = JaxConfig()
 
@@ -56,7 +65,8 @@ class JaxTrainer(DataParallelTrainer):
                  datasets: Optional[Dict[str, Any]] = None,
                  resume_from_checkpoint: Optional[Checkpoint] = None,
                  pipeline_stages: int = 1,
-                 num_microbatches: int = 1):
+                 num_microbatches: int = 1,
+                 mesh: Optional[tuple] = None):
         import dataclasses
 
         if pipeline_stages < 1:
@@ -65,21 +75,34 @@ class JaxTrainer(DataParallelTrainer):
         if num_microbatches < 1:
             raise ValueError(f"num_microbatches must be >= 1, got "
                              f"{num_microbatches}")
+        if mesh is not None and (len(mesh) != 2 or min(mesh) < 1):
+            raise ValueError(f"mesh must be (dp, tp) with both >= 1, "
+                             f"got {mesh!r}")
+        dp, tp = (int(mesh[0]), int(mesh[1])) if mesh is not None else (1, 1)
         jax_config = jax_config or JaxConfig()
-        if pipeline_stages > 1:
+        if pipeline_stages > 1 or dp > 1:
             num_workers = (scaling_config or ScalingConfig()).num_workers
-            if num_workers % pipeline_stages:
+            if dp > 1:
+                if num_workers != dp * pipeline_stages:
+                    raise ValueError(
+                        f"num_workers {num_workers} must equal dp * "
+                        f"pipeline_stages = {dp} * {pipeline_stages} (tp "
+                        f"shards each stage over its worker's local "
+                        f"devices)")
+            elif num_workers % pipeline_stages:
                 raise ValueError(
                     f"num_workers {num_workers} not divisible by "
                     f"pipeline_stages {pipeline_stages}")
             jax_config = dataclasses.replace(
-                jax_config, pipeline_stages=pipeline_stages)
-        if pipeline_stages > 1 or num_microbatches > 1:
+                jax_config, pipeline_stages=pipeline_stages, dp_replicas=dp)
+        if pipeline_stages > 1 or num_microbatches > 1 or dp > 1 or tp > 1:
             train_loop_config = dict(train_loop_config or {})
             train_loop_config["_pipeline"] = {
-                "n_stages": pipeline_stages, "n_micro": num_microbatches}
+                "n_stages": pipeline_stages, "n_micro": num_microbatches,
+                "dp": dp, "tp": tp}
         self.pipeline_stages = pipeline_stages
         self.num_microbatches = num_microbatches
+        self.mesh_shape = (dp, tp)
         super().__init__(
             train_loop_per_worker,
             train_loop_config=train_loop_config,

@@ -19,10 +19,18 @@ from ray_tpu.train.pipeline.channels import (
     stage_alive,
     stamp_progress,
 )
+from ray_tpu.train.pipeline.dp_sync import (
+    DpGradSync,
+    LocalReplicaGroup,
+    LocalReplicaMember,
+    resolve_grad_sync_flags,
+)
 from ray_tpu.train.pipeline.loop import gpt2_pipeline_loop
 from ray_tpu.train.pipeline.partition import (
+    GangCoords,
     GPT2StageModule,
     PartitionRules,
+    factor_gang,
     load_pipeline_checkpoint,
     make_shard_and_gather_fns,
     match_partition_rules,
@@ -44,7 +52,10 @@ __all__ = [
     "StageLink", "connect_links", "publish_endpoint", "stage_alive",
     "stamp_progress",
     "gpt2_pipeline_loop",
-    "GPT2StageModule", "PartitionRules", "load_pipeline_checkpoint",
+    "DpGradSync", "LocalReplicaGroup", "LocalReplicaMember",
+    "resolve_grad_sync_flags",
+    "GangCoords", "GPT2StageModule", "PartitionRules", "factor_gang",
+    "load_pipeline_checkpoint",
     "make_shard_and_gather_fns", "match_partition_rules", "pipeline_mesh",
     "save_stage_shard", "stage_ranges",
     "BubbleClock", "PipelineOp", "StageExecutor", "make_pipeline_optimizer",

@@ -1,25 +1,34 @@
 """The ready-made ``train_loop_per_worker`` for pipeline-parallel GPT-2.
 
-``JaxTrainer(gpt2_pipeline_loop, pipeline_stages=N, num_microbatches=M,
-scaling_config=ScalingConfig(num_workers=N))`` gives each worker one stage:
-the worker derives its stage id from its world rank, builds its stage module
-and gang-local mesh, rendezvouses its channels over the GCS KV, and drives
-the 1F1B executor — reporting loss/grad-norm (reduced to stage 0 by the
-schedule's commit frame) and the bubble accounting through the normal
-``train.report`` lockstep, so heartbeats, gang-skew and checkpoint retention
-all behave exactly as they do for SPMD jobs.
+``JaxTrainer(gpt2_pipeline_loop, pipeline_stages=P, mesh=(dp, tp),
+num_microbatches=M, scaling_config=ScalingConfig(num_workers=dp*P))`` gives
+each worker one (replica, stage) cell of the 3D factoring: the worker
+derives its coordinates from its world rank (replica-major; see
+``partition.factor_gang``), builds its stage module and gang-local mesh,
+rendezvouses its channels over the GCS KV (namespaced per replica), joins
+its stage's cross-replica collective group (``train/{job}/stage{k}/dp``)
+for the bucketed gradient allreduce, and drives the 1F1B executor —
+reporting loss/grad-norm (reduced to stage 0 by the schedule's commit
+frame, dp-mean across replicas) and the bubble/comm/overlap accounting
+through the normal ``train.report`` lockstep, so heartbeats, gang-skew and
+checkpoint retention all behave exactly as they do for SPMD jobs.
 
-``train_loop_config`` keys: ``steps``, ``batch_size``, ``seq_len``,
+``train_loop_config`` keys: ``steps``, ``batch_size`` (GLOBAL batch; each
+replica trains on its contiguous ``batch_size/dp`` row slice), ``seq_len``,
 ``model`` (GPT2Config field overrides, applied over ``GPT2Config.tiny()``),
 ``lr``, ``seed``, ``timeout_s``, ``checkpoint_every`` (0 = only the final
-step checkpoints).  The driver injects ``_pipeline`` = {n_stages, n_micro}.
+step checkpoints), plus the dp grad-exchange knobs ``grad_bucket_bytes`` /
+``grad_quant`` / ``dp_quorum`` (fall back to the ``train_grad_*`` config
+flags, env-first).  The driver injects ``_pipeline`` = {n_stages, n_micro,
+dp, tp}.
 
 Checkpoint layout: every stage leader writes its gathered slice as
 ``pipe_stage.npz`` keyed by CANONICAL layer names; the trainer's persist
 step files stage 0's under the checkpoint dir and the rest under
 ``rank_<k>/``.  Restore merges every shard and re-selects this job's
-slices, so an N-stage checkpoint restores onto any other stage count
-bit-exact after gather.
+slices (dp replicas write identical shards — the dp-mean grads and commit
+frame are replica-consistent, so params never diverge), so an N-stage
+checkpoint restores onto any other stage count bit-exact after gather.
 """
 
 from __future__ import annotations
@@ -35,27 +44,36 @@ import numpy as np
 def gpt2_pipeline_loop(config: Dict[str, Any]) -> None:
     from ray_tpu import train
     from ray_tpu.models.gpt2 import GPT2Config
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
     from ray_tpu.train.pipeline import channels as pipechan
+    from ray_tpu.train.pipeline.dp_sync import DpGradSync
     from ray_tpu.train.pipeline.partition import (
-        GPT2StageModule, load_pipeline_checkpoint, pipeline_mesh,
-        save_stage_shard)
+        GPT2StageModule, factor_gang, load_pipeline_checkpoint,
+        pipeline_mesh, save_stage_shard)
     from ray_tpu.train.pipeline.schedule import StageExecutor
 
     ctx = train.get_context()
     pcfg = config.get("_pipeline") or {"n_stages": 1, "n_micro": 1}
     n_stages, n_micro = int(pcfg["n_stages"]), int(pcfg["n_micro"])
+    dp = int(pcfg.get("dp", 1))
+    tp = int(pcfg.get("tp", 1))
     world = ctx.get_world_size()
-    if world % n_stages:
+    if world % (dp * n_stages):
         raise ValueError(
-            f"num_workers {world} not divisible by pipeline_stages {n_stages}")
-    gang_size = world // n_stages
-    if gang_size != 1 and n_stages > 1:
+            f"num_workers {world} not divisible by dp*pipeline_stages "
+            f"{dp}*{n_stages}")
+    coords = factor_gang(ctx.get_world_rank(), world, dp=dp,
+                         n_stages=n_stages)
+    if coords.gang_size != 1 and (n_stages > 1 or dp > 1):
         raise NotImplementedError(
             "multi-process stage gangs are not composed yet: use "
-            "num_workers == pipeline_stages (each stage still shards over "
-            "its worker's local devices)")
-    stage = ctx.get_world_rank() // gang_size
+            "num_workers == dp * pipeline_stages (tp shards each stage "
+            "over its worker's local devices)")
+    stage, replica = coords.stage, coords.replica
     job = config.get("job") or ctx.get_experiment_name()
+    # channels rendezvous per REPLICA: each replica runs its own 1F1B
+    # pipeline, so its act/grad links must never cross replicas
+    chjob = job if dp == 1 else f"{job}/r{replica}"
 
     model_cfg = GPT2Config.tiny()
     overrides = dict(config.get("model") or {})
@@ -71,16 +89,59 @@ def gpt2_pipeline_loop(config: Dict[str, Any]) -> None:
     seq_len = int(config.get("seq_len", min(32, model_cfg.n_positions)))
     ckpt_every = int(config.get("checkpoint_every", 0))
     timeout_s = float(config.get("timeout_s", 60.0))
+    if batch_size % dp:
+        raise ValueError(
+            f"global batch_size {batch_size} not divisible by dp {dp}")
+    rep_batch = batch_size // dp
 
     module = GPT2StageModule(model_cfg, stage, n_stages)
-    mesh = pipeline_mesh()
-    links = pipechan.connect_links(job, stage, n_stages, n_micro,
+    if tp > 1:
+        import jax
+
+        devs = jax.devices()
+        if len(devs) < tp:
+            raise ValueError(
+                f"mesh tp={tp} needs {tp} local devices per stage worker, "
+                f"have {len(devs)} (raise JaxConfig.cpu_devices_per_worker)")
+        mesh = build_mesh(MeshConfig(dp=1, tp=tp), devices=devs[:tp])
+    elif dp > 1:
+        # composed mode: the data-parallel axis is CROSS-process; every
+        # local device goes to tp so the in-worker mesh never re-splits
+        # the replica's batch rows
+        mesh = pipeline_mesh(max_dp=1)
+    else:
+        mesh = pipeline_mesh()
+    links = pipechan.connect_links(chjob, stage, n_stages, n_micro,
                                    timeout_s=timeout_s) if n_stages > 1 else {}
+
+    dp_sync = None
+    dp_group_name = None
+    if dp > 1:
+        from ray_tpu.util import collective
+
+        dp_group_name = coords.dp_group_name(job)
+        # persistent per-stage group, reused across every step (re-creating
+        # it per step would leak a rendezvous key set per step)
+        member = collective.get_or_init_collective_group(
+            dp, replica, backend="cpu", group_name=dp_group_name)
+        dp_sync = DpGradSync(
+            member,
+            bucket_bytes=config.get("grad_bucket_bytes"),
+            quant=config.get("grad_quant"),
+            quorum=config.get("dp_quorum"),
+            timeout_s=timeout_s)
+
     executor = StageExecutor(
         module, mesh, n_micro=n_micro, links=links,
         lr=float(config.get("lr", 3e-4)), total_steps=max(steps, 101),
-        timeout_s=timeout_s, job=job, experiment=ctx.get_experiment_name(),
-        seed=int(config.get("seed", 0)))
+        timeout_s=timeout_s, job=chjob, experiment=ctx.get_experiment_name(),
+        seed=int(config.get("seed", 0)), dp_sync=dp_sync, replica=replica)
+
+    def _destroy_dp():
+        if dp_group_name is not None:
+            from ray_tpu.util import collective
+
+            collective.destroy_collective_group(dp_group_name)
 
     start_step = 0
     ckpt = train.get_checkpoint()
@@ -104,11 +165,14 @@ def gpt2_pipeline_loop(config: Dict[str, Any]) -> None:
         # cross-stage-count restore is observable without training further
         train.report({"step": start_step - 1, "stage": stage,
                       "restored": True}, checkpoint=_checkpoint(start_step - 1))
+        _destroy_dp()
         return
 
     for step in range(start_step, steps):
         # every stage derives the SAME global batch from the seeded stream
-        # (stage 0 reads input_ids, the last stage reads targets)
+        # (stage 0 reads input_ids, the last stage reads targets); each
+        # replica trains on its contiguous row slice, so the dp-mean grad
+        # equals the full-batch grad up to fp reassociation
         rng = np.random.default_rng((rng_seed << 20) + step)
         batch = {
             "input_ids": rng.integers(
@@ -118,12 +182,18 @@ def gpt2_pipeline_loop(config: Dict[str, Any]) -> None:
                 0, model_cfg.vocab_size, (batch_size, seq_len),
                 dtype=np.int32),
         }
+        if dp > 1:
+            lo = replica * rep_batch
+            batch = {k: v[lo:lo + rep_batch] for k, v in batch.items()}
         out = executor.train_step(batch)
         checkpoint = None
         if step == steps - 1 or (ckpt_every and (step + 1) % ckpt_every == 0):
             checkpoint = _checkpoint(step)
         train.report({k: out[k] for k in
-                      ("loss", "grad_norm", "step", "stage", "step_wall_s",
-                       "busy_s", "xfer_s", "bubble_s", "bubble_fraction")},
+                      ("loss", "grad_norm", "step", "stage", "replica",
+                       "step_wall_s", "busy_s", "xfer_s", "bubble_s",
+                       "bubble_fraction", "comm_s", "overlap_fraction",
+                       "dp_wire_bytes")},
                      checkpoint=checkpoint)
     executor.close()
+    _destroy_dp()

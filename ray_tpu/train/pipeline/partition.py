@@ -53,6 +53,51 @@ def stage_ranges(n_layer: int, n_stages: int) -> List[Tuple[int, int]]:
     return ranges
 
 
+# ----------------------------------------------------------- gang factoring
+@dataclasses.dataclass(frozen=True)
+class GangCoords:
+    """One worker's position in the 3D factoring dp × pp × tp.
+
+    Replica-major layout over world ranks: with ``g`` workers per stage
+    gang, rank r maps to replica ``r // (P*g)``, stage ``(r // g) % P``,
+    in-gang index ``r % g``.  All stage gangs of one replica are
+    contiguous, so a replica is a contiguous rank block — the per-replica
+    data shard is then just a contiguous row slice of the global batch."""
+    replica: int
+    stage: int
+    gang_rank: int
+    dp: int
+    n_stages: int
+    gang_size: int
+
+    def dp_group_name(self, job: str) -> str:
+        """Name (= KV-rendezvous key under ``collective/``) of this
+        stage's cross-replica collective group: one persistent group per
+        stage carrying the gradient allreduce, namespaced by job so two
+        concurrent trainers never collide."""
+        return f"train/{job}/stage{self.stage}/dp"
+
+
+def factor_gang(world_rank: int, world_size: int, *, dp: int,
+                n_stages: int) -> GangCoords:
+    """Factor a flat trainer world into dp replicas × n_stages stage
+    gangs (replica-major).  ``world_size`` must be divisible by
+    ``dp * n_stages``; the quotient is the per-stage gang size."""
+    worlds = dp * n_stages
+    if dp < 1 or n_stages < 1:
+        raise ValueError(f"dp={dp} and n_stages={n_stages} must be >= 1")
+    if world_size % worlds:
+        raise ValueError(
+            f"world size {world_size} not divisible by dp*stages={worlds}")
+    gang_size = world_size // worlds
+    if not 0 <= world_rank < world_size:
+        raise ValueError(f"rank {world_rank} out of range")
+    w = world_rank // gang_size
+    return GangCoords(replica=w // n_stages, stage=w % n_stages,
+                      gang_rank=world_rank % gang_size, dp=dp,
+                      n_stages=n_stages, gang_size=gang_size)
+
+
 # ------------------------------------------------- graceful mesh degradation
 def pipeline_mesh(devices=None, *, max_dp: Optional[int] = None):
     """A gang-local mesh for one stage, shaped to whatever devices the gang

@@ -90,7 +90,12 @@ def theoretical_bubble_fraction(n_stages: int, n_micro: int) -> float:
 # ------------------------------------------------------------- bubble clock
 class BubbleClock:
     """Per-step wall-clock split: compute (fwd/bwd/optim), transfer
-    (send/serialize), wait (blocked on a peer — the bubble)."""
+    (send/serialize), wait (blocked on a peer — the bubble), comm (the dp
+    collective: bucket packing/launch + time blocked at the clip barrier).
+
+    ``comm`` is its own bucket so collective waits don't inflate ``wait``:
+    the bubble fraction keeps meaning "1F1B schedule stall", and overlap
+    claims are measured against the comm bucket instead of inferred."""
 
     def __init__(self):
         self.reset()
@@ -99,11 +104,14 @@ class BubbleClock:
         self.compute_s = 0.0
         self.xfer_s = 0.0
         self.wait_s = 0.0
+        self.comm_s = 0.0
         self._t0 = time.monotonic()
 
     def charge(self, kind: str, seconds: float):
         if kind in ("fwd", "bwd", "optim"):
             self.compute_s += seconds
+        elif kind == "comm":
+            self.comm_s += seconds
         elif kind.startswith("send"):
             self.xfer_s += seconds
         else:
@@ -117,6 +125,7 @@ class BubbleClock:
             "xfer_s": self.xfer_s,
             "bubble_s": self.wait_s,
             "bubble_fraction": self.wait_s / wall,
+            "comm_s": self.comm_s,
         }
 
 
@@ -143,7 +152,8 @@ class StageExecutor:
                  lr: float = 3e-4, total_steps: int = 10_000,
                  clip_norm: float = 1.0, timeout_s: Optional[float] = None,
                  job: str = "", experiment: str = "", seed: int = 0,
-                 params: Optional[Dict[str, Any]] = None):
+                 params: Optional[Dict[str, Any]] = None,
+                 dp_sync: Optional[Any] = None, replica: int = 0):
         import jax
         import jax.numpy as jnp
 
@@ -161,9 +171,15 @@ class StageExecutor:
                           else pipechan.DEFAULT_TIMEOUT_S)
         self.job = job
         self.experiment = experiment
+        # dp composition: a DpGradSync over this stage's cross-replica
+        # collective group.  None = single replica (the legacy exact path:
+        # grad-norm partials ride the last upstream grad frame).
+        self.dp_sync = dp_sync
+        self.replica = int(replica)
         self.ops = one_f_one_b(self.stage, self.n_stages, self.n_micro)
         self.clock = BubbleClock()
         self.step_idx = 0
+        self._op_comm_s = 0.0
 
         host_params = params if params is not None else module.init_params(seed)
         self.specs = module.specs(host_params)
@@ -263,6 +279,7 @@ class StageExecutor:
         import jax
 
         self.clock.reset()
+        self._op_comm_s = 0.0
         step = self.step_idx
         acts: Dict[int, Any] = {}     # micro -> received/embedded input act
         grads_accum = None
@@ -330,30 +347,62 @@ class StageExecutor:
                     else self._f_add(grads_accum, gp)
                 self._gx = gx
                 jax.block_until_ready(grads_accum)  # same: truthful buckets
+                if self.dp_sync is not None and i == self.n_micro - 1:
+                    # bucket-ready hook: the accumulated grads are final
+                    # the moment the last backward microbatch lands —
+                    # launch the bucketed dp allreduces NOW so the wire
+                    # overlaps the remaining drain (send_grad frames +
+                    # peer stages' cooldown), not serializes after it
+                    tc = time.monotonic()
+                    self.dp_sync.launch(grads_accum)
+                    self._op_comm_s += time.monotonic() - tc
             elif op.kind == "send_grad":
                 payload = {"g": np.asarray(jax.device_get(self._gx)),
                            "loss": losses[i] if mod.is_last else
                            (losses[i] if i < len(losses) else None),
                            "gnormsq": None}
-                if i == self.n_micro - 1:
+                if i == self.n_micro - 1 and self.dp_sync is None:
                     own = float(self._f_gnormsq(grads_accum)) \
                         / float(self.n_micro) ** 2
                     payload["gnormsq"] = own + (below_gnormsq or 0.0)
                 self.links["grad_out"].send(f"{step}.g{i}", payload,
                                             timeout_s=tmo)
             elif op.kind == "optim":
-                commit = self._commit(grads_accum, losses, below_gnormsq,
-                                      step, tmo)
-                scale = (1.0 / self.n_micro) * commit["clip_scale"]
-                self.params, self.opt_state = self._f_apply(
-                    self.params, self.opt_state, grads_accum, scale)
-            self.clock.charge(op.kind, time.monotonic() - t0)
+                if self.dp_sync is not None:
+                    # wait-at-clip-barrier: the reduced grads are needed
+                    # for the norm, so this is the latest possible wait
+                    tc = time.monotonic()
+                    grads_red = self.dp_sync.wait_all(timeout_s=tmo)
+                    self._op_comm_s += time.monotonic() - tc
+                    commit = self._commit_dp(grads_red, losses, step, tmo)
+                    scale = (1.0 / self.n_micro) * commit["clip_scale"]
+                    self.params, self.opt_state = self._f_apply(
+                        self.params, self.opt_state, grads_red, scale)
+                else:
+                    commit = self._commit(grads_accum, losses, below_gnormsq,
+                                          step, tmo)
+                    scale = (1.0 / self.n_micro) * commit["clip_scale"]
+                    self.params, self.opt_state = self._f_apply(
+                        self.params, self.opt_state, grads_accum, scale)
+            dt = time.monotonic() - t0
+            comm = min(self._op_comm_s, dt)
+            self._op_comm_s = 0.0
+            if comm > 0.0:
+                self.clock.charge("comm", comm)
+            self.clock.charge(op.kind, dt - comm)
 
         self.step_idx += 1
         out = self.clock.summary()
         out.update({"loss": commit["loss_mean"],
                     "grad_norm": commit["gnorm"],
-                    "stage": self.stage, "step": step})
+                    "stage": self.stage, "step": step,
+                    "replica": self.replica,
+                    "overlap_fraction":
+                        self.dp_sync.last_overlap_fraction()
+                        if self.dp_sync is not None else 0.0,
+                    "dp_wire_bytes":
+                        self.dp_sync.last_wire_bytes
+                        if self.dp_sync is not None else 0})
         self._emit_metrics(out)
         return out
 
@@ -380,6 +429,48 @@ class StageExecutor:
             if gnorm > 0 else 1.0
         return commit
 
+    def _commit_dp(self, grads_red, losses, step: int,
+                   tmo: float) -> Dict[str, float]:
+        """dp-composed commit: the norm partials cross BOTH the stage
+        frames and the dp allreduce, yet stay exact.
+
+        The dp-mean grads returned by ``wait_all`` are identical on every
+        replica (one consistent reduction result), so each stage's
+        ``own_sq`` is replica-consistent by construction.  Partials then
+        flow upstream over a dedicated ``{step}.n`` frame on the grad
+        links (they can't ride the grad frames as in the dp=1 path: those
+        were sent before the allreduce completed), and stage 0 folds ONE
+        extra scalar allreduce — dp-mean of [loss_mean, total_sq], exact
+        and full-participation — into the commit frame it broadcasts
+        downstream.  Averaging replica-identical values is bitwise stable,
+        so dp=2 reproduces the dp=1 norm bit-for-bit (regression-tested).
+        """
+        own_sq = float(self._f_gnormsq(grads_red)) / float(self.n_micro) ** 2
+        below = 0.0
+        if "grad_in" in self.links:
+            below = float(self.links["grad_in"].recv(f"{step}.n",
+                                                     timeout_s=tmo))
+        subtotal = own_sq + below
+        if self.stage == 0:
+            loss_local = float(np.mean(losses)) if losses else float("nan")
+            tc = time.monotonic()
+            vec = self.dp_sync.allreduce_scalars([loss_local, subtotal],
+                                                 timeout_s=tmo)
+            self._op_comm_s += time.monotonic() - tc
+            commit = {"gnorm": float(np.sqrt(float(vec[1]))),
+                      "loss_mean": float(vec[0])}
+            if "act_out" in self.links:
+                self.links["act_out"].send(f"{step}.c", commit, timeout_s=tmo)
+        else:
+            self.links["grad_out"].send(f"{step}.n", subtotal, timeout_s=tmo)
+            commit = self.links["act_in"].recv(f"{step}.c", timeout_s=tmo)
+            if "act_out" in self.links:
+                self.links["act_out"].send(f"{step}.c", commit, timeout_s=tmo)
+        gnorm = commit["gnorm"]
+        commit["clip_scale"] = min(1.0, self.clip_norm / gnorm) \
+            if gnorm > 0 else 1.0
+        return commit
+
     def _emit_metrics(self, out: Dict[str, Any]) -> None:
         try:
             from ray_tpu.train._metrics import train_metrics
@@ -390,6 +481,11 @@ class StageExecutor:
             m["pipeline_bubble"].inc(out["bubble_s"], labels)
             m["pipeline_bubble_fraction"].set(out["bubble_fraction"], labels)
             m["pipeline_stage_busy"].set(out["busy_s"], labels)
+            m["pipeline_comm"].inc(out["comm_s"], labels)
+            m["pipeline_overlap_fraction"].set(out["overlap_fraction"],
+                                               labels)
+            if out.get("dp_wire_bytes"):
+                m["train_dp_wire_bytes"].inc(out["dp_wire_bytes"], labels)
         except Exception:
             pass  # metrics must never fail a step
 

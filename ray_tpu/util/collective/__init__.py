@@ -13,6 +13,7 @@ gloo_collective_group.py:184).  Two backends, TPU-native split:
 """
 
 from ray_tpu.util.collective.collective import (
+    AsyncCollectiveHandle,
     allgather,
     allreduce,
     barrier,
@@ -20,19 +21,23 @@ from ray_tpu.util.collective.collective import (
     destroy_collective_group,
     get_collective_group_size,
     get_group_progress,
+    get_or_init_collective_group,
     get_rank,
     init_collective_group,
     recv,
     reducescatter,
     rejoin_collective_group,
     send,
+    wait_all,
 )
 from ray_tpu.util.collective import quantization, topology, xla
 
 __all__ = [
     "init_collective_group", "rejoin_collective_group",
+    "get_or_init_collective_group",
     "destroy_collective_group", "allreduce",
     "allgather", "reducescatter", "broadcast", "send", "recv", "barrier",
+    "wait_all", "AsyncCollectiveHandle",
     "get_rank", "get_collective_group_size", "get_group_progress",
     "quantization", "topology", "xla",
 ]

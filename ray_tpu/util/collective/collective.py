@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import queue
 import socket
 import threading
 import time
@@ -92,6 +93,58 @@ def _check_quant(quant: Optional[str]) -> None:
                          f"{QUANT_MODES}")
 
 
+class AsyncCollectiveHandle:
+    """Completion handle for one asynchronously launched collective op.
+
+    The op itself runs on the group's single background comm thread, which
+    drains a FIFO queue — so as long as every rank enqueues the same ops in
+    the same order, cross-rank seq alignment is preserved exactly as in the
+    blocking API.  After completion the handle carries the op's result,
+    its wire bytes (this rank's share) and the seconds the op spent
+    executing on the comm thread (``op_seconds``), which callers use for
+    overlap accounting."""
+
+    def __init__(self, op_name: str = "allreduce"):
+        self.op_name = op_name
+        self._done = threading.Event()
+        self._result = None
+        self._exc: Optional[BaseException] = None
+        self.wire_bytes = 0
+        self.op_seconds = 0.0
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout_s: Optional[float] = None):
+        """Block until the op completes and return its result (or re-raise
+        its failure).  ``timeout_s`` bounds the wait — it covers queueing
+        delay too, so a backed-up comm thread surfaces as CollectiveTimeout
+        here rather than a silent hang."""
+        if timeout_s is None:
+            timeout_s = RayConfig.collective_default_timeout_s
+        if not self._done.wait(timeout_s):
+            raise CollectiveTimeout(
+                f"async {self.op_name}: not complete after {timeout_s}s "
+                f"(op still queued or executing on the comm thread)")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+def wait_all(handles: Sequence[AsyncCollectiveHandle],
+             timeout_s: Optional[float] = None) -> list:
+    """Wait on a batch of async handles under ONE shared deadline and
+    return their results in order.  The first failure propagates; the
+    shared deadline means N slow buckets cost one timeout budget, not N."""
+    if timeout_s is None:
+        timeout_s = RayConfig.collective_default_timeout_s
+    deadline = time.monotonic() + timeout_s
+    out = []
+    for h in handles:
+        out.append(h.wait(timeout_s=max(0.001, deadline - time.monotonic())))
+    return out
+
+
 class Group:
     def __init__(self, name: str, world_size: int, rank: int, gen: int = 0):
         self.name = name
@@ -135,6 +188,11 @@ class Group:
         # Same-host shm chunk channel (lazy: first eligible bulk send).
         self._shm_tx: Optional[shm_ch.TxArena] = None
         self._shm_rx = shm_ch.RxCache()
+        # Async op plumbing: ONE background comm thread per group drains a
+        # FIFO queue, so concurrently launched ops stay serialized in enqueue
+        # order and cross-rank seq alignment is preserved (lazy start).
+        self._comm_q: Optional[queue.Queue] = None
+        self._comm_thread: Optional[threading.Thread] = None
         # Per-rank liveness: each op start stamps (seq, op, ts) into the KV
         # rendezvous AND a local gauge, so a peer stuck waiting can name the
         # rank whose progress lags (straggler diagnosis; reference:
@@ -905,6 +963,55 @@ class Group:
         finally:
             self._finish_op(_op_name, quant)
 
+    # ------------------------------------------------------------ async ops
+    def _comm_loop(self) -> None:
+        while True:
+            item = self._comm_q.get()
+            if item is None:
+                return
+            fn, handle = item
+            t0 = time.monotonic()
+            try:
+                handle._result = fn()
+                # comm thread is the only executor of this group's async
+                # ops, so _op_bytes still holds THIS op's tally here.
+                handle.wire_bytes = self._op_bytes
+            except BaseException as e:  # surfaced at handle.wait()
+                handle._exc = e
+            handle.op_seconds = time.monotonic() - t0
+            handle._done.set()
+
+    def _comm_submit(self, fn, op_name: str) -> AsyncCollectiveHandle:
+        if self._comm_thread is None or not self._comm_thread.is_alive():
+            self._comm_q = queue.Queue()
+            self._comm_thread = threading.Thread(
+                target=self._comm_loop, daemon=True,
+                name=f"col-comm-{self.name}")
+            self._comm_thread.start()
+        handle = AsyncCollectiveHandle(op_name=op_name)
+        self._comm_q.put((fn, handle))
+        return handle
+
+    def allreduce_async(self, array, op: str = "sum",
+                        timeout_s: Optional[float] = None,
+                        quant: Optional[str] = None,
+                        quorum: Optional[int] = None) -> AsyncCollectiveHandle:
+        """Launch an allreduce on the comm thread and return immediately.
+
+        The caller overlaps compute with the transfer and collects the
+        result via ``handle.wait(timeout_s)`` / module-level
+        :func:`wait_all`.  All of a group's async ops (and any blocking ops
+        issued through :meth:`allreduce_async` + immediate wait) share the
+        one comm thread, so every rank observing the same launch order
+        keeps the same wire seq order — the invariant the blocking API gets
+        for free."""
+        _check_quant(quant)
+        arr = np.asarray(array)
+        return self._comm_submit(
+            lambda: self.allreduce(arr, op, timeout_s=timeout_s,
+                                   quant=quant, quorum=quorum),
+            "allreduce")
+
     def allgather(self, array, timeout_s: Optional[float] = None,
                   quant: Optional[str] = None) -> List[np.ndarray]:
         """Gather every rank's array.  With ``quant="int8"`` each entry —
@@ -1071,7 +1178,15 @@ class Group:
         self._stamp_progress(op, self.seq)
         return self.seq
 
+    def _stop_comm_thread(self) -> None:
+        if self._comm_thread is not None and self._comm_thread.is_alive():
+            self._comm_q.put(None)
+            self._comm_thread.join(timeout=5.0)
+        self._comm_thread = None
+        self._comm_q = None
+
     def destroy(self):
+        self._stop_comm_thread()
         self.core.server.handlers.pop(self._handler_name, None)
         if self._shm_tx is not None:
             self._shm_tx.close()
@@ -1118,6 +1233,9 @@ class Group:
             world_size = len(survivors) if world_size is None else world_size
             rank = survivors.index(self.rank) if rank is None else rank
         # tear down the dead incarnation
+        self._stop_comm_thread()
+        old_prefix = self._prefix
+        old_world = self.world_size
         self.core.server.handlers.pop(self._handler_name, None)
         with self._inbox_cv:
             self._inbox.clear()
@@ -1139,6 +1257,37 @@ class Group:
         self.seq = 0
         self._handler_name = self._handler_basename()
         self.core.server.handlers[self._handler_name] = self._on_message
+        try:
+            # Sweep the dead incarnation's rendezvous keys.  Without this,
+            # every rebuild leaks a `collective/<name>[@g<n>]/...` key set
+            # per generation and long-lived groups (the persistent dp
+            # gradient groups rebuild in place on rank death) would grow
+            # the KV unboundedly.  Every survivor attempts it (idempotent
+            # deletes; in replace mode the restarted rank may be rank 0
+            # and never see this path).  Two keys classes are deliberately
+            # NOT swept with their generation:
+            #  - `{old_prefix}/dead/*` — a slow survivor may still be
+            #    inside the dying op, and the dead marker is what lets it
+            #    detect the death in seconds instead of burning the full
+            #    op timeout (and missing this rendezvous).  Markers are
+            #    reaped one rebuild LATER, once every survivor has
+            #    provably left that generation.
+            #  - `collective/<name>/gen` — the rejoin pointer lives under
+            #    the gen-0 prefix; prefix-deleting `collective/<name>/`
+            #    from a slow survivor would eat the pointer a fast
+            #    survivor already re-advertised, stranding a restarted
+            #    rank mid-rejoin.  Targeted deletes spare it.
+            for r in range(old_world):
+                self._kv("kv_del", ns="collective", key=f"{old_prefix}/{r}")
+            self._kv("kv_del", ns="collective",
+                     key=old_prefix + "/progress/", prefix=True)
+            if self._gen >= 2:
+                gp = (f"collective/{self.name}" if self._gen == 2
+                      else f"collective/{self.name}@g{self._gen - 2}")
+                self._kv("kv_del", ns="collective", key=gp + "/dead/",
+                         prefix=True)
+        except Exception:
+            pass
         try:
             # advertise the generation so a restarted rank can rejoin
             self._kv("kv_put", ns="collective",
@@ -1175,6 +1324,35 @@ def init_collective_group(world_size: int, rank: int, backend: str = "cpu",
         if group_name in _groups:
             raise RuntimeError(f"collective group {group_name!r} already initialized")
         _groups[group_name] = Group(group_name, world_size, rank)
+
+
+def get_or_init_collective_group(world_size: int, rank: int,
+                                 backend: str = "cpu",
+                                 group_name: str = "default") -> Group:
+    """Idempotent :func:`init_collective_group` that returns the Group.
+
+    Per-step callers (e.g. the dp gradient exchange, which needs the same
+    ``train/<name>/stage<k>/dp`` group every training step) must REUSE one
+    persistent group: re-initializing each step would leak a fresh set of
+    rendezvous keys per step and re-pay the registration round trip.  A
+    cached group is returned only when its membership matches; a mismatch
+    is a caller bug and raises."""
+    if backend not in ("cpu", "gloo", "xla"):
+        raise ValueError(f"unsupported backend {backend!r}; use 'cpu' or 'xla'")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    with _lock:
+        g = _groups.get(group_name)
+        if g is not None:
+            if g.world_size != world_size or g.rank != rank:
+                raise RuntimeError(
+                    f"collective group {group_name!r} already initialized "
+                    f"with world_size={g.world_size}, rank={g.rank}; "
+                    f"requested world_size={world_size}, rank={rank}")
+            return g
+        g = Group(group_name, world_size, rank)
+        _groups[group_name] = g
+        return g
 
 
 def rejoin_collective_group(world_size: int, rank: int, backend: str = "cpu",

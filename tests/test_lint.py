@@ -315,6 +315,41 @@ collective.barrier("g")    # inherits the def's bounded default
     assert result.findings == []
 
 
+def test_collective_timeout_wait_is_an_op_token():
+    """The async-handle surface (`wait_all`, handle waits, bucket barriers)
+    can park a caller exactly like a blocking collective: `wait`-named
+    public defs in util/collective/ must be bounded."""
+    bad = FileCtx("ray_tpu/util/collective/collective.py", '''
+def wait_all(handles):                        # BAD: unbounded barrier
+    pass
+def wait_all_bounded(handles, timeout_s=None):  # bounded: fine
+    pass
+''')
+    result = run_lint(files=[bad], checkers=["collective-timeout"],
+                      baseline=None)
+    assert rules_of(result.findings) == ["collective-timeout.def"]
+    assert "`wait_all`" in result.findings[0].message
+
+
+def test_collective_timeout_pipeline_wait_defs():
+    """Inside train/pipeline/ the same rule covers the grad-exchange
+    barriers: a public `*wait*` def without timeout_s is flagged, while
+    wait CALLS stay def-side-only (h.wait() inherits the def's default)."""
+    pipe = FileCtx("ray_tpu/train/pipeline/dp_sync.py", '''
+def wait_all(self, timeout_s=None):           # bounded barrier: fine
+    pass
+def bucket_wait(handle):                      # BAD: unbounded stage wait
+    pass
+def _drain_wait(handle):                      # private: exempt
+    pass
+h.wait()                                      # call level: def-side-only
+''')
+    result = run_lint(files=[pipe], checkers=["collective-timeout"],
+                      baseline=None)
+    assert rules_of(result.findings) == ["collective-timeout.def"]
+    assert "`bucket_wait`" in result.findings[0].message
+
+
 # ============================================== jax-tracer-hygiene
 
 def test_tracer_hygiene_positives():
