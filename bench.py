@@ -346,6 +346,48 @@ def main() -> None:
         except Exception as e:
             result["micro_error"] = repr(e)
 
+    # Shared noop round-trip rate probe: a fresh runtime in a subprocess
+    # measures sync-task throughput under `extra_env`.  Both the watchdog
+    # and flight-recorder overhead guards A/B against it.
+    import subprocess
+    import sys
+
+    rate_code = (
+        "import json, time, ray_tpu\n"
+        "from ray_tpu._private.ray_perf import host_cpu_count\n"
+        "ray_tpu.init(num_cpus=host_cpu_count(), "
+        "object_store_memory=1024**3)\n"
+        "@ray_tpu.remote\n"
+        "def noop():\n"
+        "    return None\n"
+        "ray_tpu.get(noop.remote())\n"
+        "t0 = time.perf_counter(); n = 0\n"
+        "while time.perf_counter() - t0 < 2.0:\n"
+        "    ray_tpu.get(noop.remote()); n += 1\n"
+        "print('RATE=' + json.dumps(round(n / "
+        "(time.perf_counter() - t0), 1)))\n")
+
+    def _noop_rate(extra_env):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.update(extra_env)
+        proc = subprocess.Popen([sys.executable, "-c", rate_code],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE, text=True,
+                                env=env, start_new_session=True)
+        try:
+            stdout, _ = proc.communicate(timeout=90)
+        except subprocess.TimeoutExpired:
+            import signal
+
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait()
+            return None
+        for line in stdout.splitlines():
+            if line.startswith("RATE="):
+                return json.loads(line[len("RATE="):])
+        return None
+
     # Watchdog/sampler overhead guard (ISSUE 3): the hang watchdog polls
     # every busy worker and the stack sampler rides the worker RPC loop —
     # both must be free on the task hot path.  Measure the same noop
@@ -353,45 +395,6 @@ def main() -> None:
     # disabled; both numbers land in the bench record so a regression shows
     # up as a ratio drift, not a silent slowdown.
     if os.environ.get("RAY_TPU_BENCH_MICRO", "1") != "0":
-        import subprocess
-        import sys
-
-        rate_code = (
-            "import json, time, ray_tpu\n"
-            "from ray_tpu._private.ray_perf import host_cpu_count\n"
-            "ray_tpu.init(num_cpus=host_cpu_count(), "
-            "object_store_memory=1024**3)\n"
-            "@ray_tpu.remote\n"
-            "def noop():\n"
-            "    return None\n"
-            "ray_tpu.get(noop.remote())\n"
-            "t0 = time.perf_counter(); n = 0\n"
-            "while time.perf_counter() - t0 < 2.0:\n"
-            "    ray_tpu.get(noop.remote()); n += 1\n"
-            "print('RATE=' + json.dumps(round(n / "
-            "(time.perf_counter() - t0), 1)))\n")
-
-        def _noop_rate(extra_env):
-            env = dict(os.environ)
-            env["JAX_PLATFORMS"] = "cpu"
-            env.update(extra_env)
-            proc = subprocess.Popen([sys.executable, "-c", rate_code],
-                                    stdout=subprocess.PIPE,
-                                    stderr=subprocess.PIPE, text=True,
-                                    env=env, start_new_session=True)
-            try:
-                stdout, _ = proc.communicate(timeout=90)
-            except subprocess.TimeoutExpired:
-                import signal
-
-                os.killpg(proc.pid, signal.SIGKILL)
-                proc.wait()
-                return None
-            for line in stdout.splitlines():
-                if line.startswith("RATE="):
-                    return json.loads(line[len("RATE="):])
-            return None
-
         try:
             on = _noop_rate({"RAY_TPU_HANG_WATCHDOG_INTERVAL_S": "0.5"})
             off = _noop_rate({"RAY_TPU_HANG_WATCHDOG_INTERVAL_S": "0"})
@@ -402,6 +405,27 @@ def main() -> None:
             }
         except Exception as e:
             result["watchdog_overhead"] = {"error": repr(e)}
+
+    # Flight-recorder overhead guard (ISSUE 16): the black-box ring write
+    # rides every task start/end (plus collective/pipeline/lease seams), so
+    # its cost must be invisible on the sync hot path — the same bar the
+    # watchdog met.  Interleaved A/B (alternating recorder-on/off rounds,
+    # best-of per arm) cancels machine drift out of the ratio.
+    if os.environ.get("RAY_TPU_BENCH_FLIGHTREC", "1") != "0":
+        try:
+            on = off = None
+            for _ in range(2):
+                r_on = _noop_rate({})  # recorder on: the shipped default
+                r_off = _noop_rate({"RAY_TPU_FLIGHT_RECORDER_BYTES": "0"})
+                on = max(on or 0.0, r_on) if r_on else on
+                off = max(off or 0.0, r_off) if r_off else off
+            result["flight_recorder"] = {
+                "tasks_sync_recorder_on": on,
+                "tasks_sync_recorder_off": off,
+                "ratio": round(on / off, 3) if on and off else None,
+            }
+        except Exception as e:
+            result["flight_recorder"] = {"error": repr(e)}
 
     # LLM continuous-batching decode throughput (ISSUE 4): tiny model on
     # the numpy engine — in-process (no runtime), so the number isolates
@@ -630,8 +654,8 @@ def main() -> None:
     # never compare a pinned 8-core number against an unpinned 1-core one
     # without seeing the difference in the row itself.
     for key in ("micro", "collective", "recovery", "pipeline", "train_3d",
-                "llm_decode_throughput", "watchdog_overhead", "lint_tree",
-                "serve_load"):
+                "llm_decode_throughput", "watchdog_overhead",
+                "flight_recorder", "lint_tree", "serve_load"):
         if isinstance(result.get(key), dict):
             bench_rig.stamp(result[key], rig)
 

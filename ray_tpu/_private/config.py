@@ -144,6 +144,19 @@ _d("chaos_trace_file", str, "",
 _d("chaos_delay_ms", int, 25,
    "duration of the 'delay' action on rpc.frame.send")
 
+# --- Flight recorder + incidents (see _private/flight_recorder.py) ---
+_d("flight_recorder_bytes", int, 256 * 1024,
+   "size of each process's crash-surviving mmap'd flight-recorder ring "
+   "file in the session dir (the 'black box' the nodelet harvests when "
+   "the process dies); 0 disables recording")
+_d("incident_retention", int, 256,
+   "closed failure incidents and harvested worker black boxes kept by "
+   "the GCS (and by each process's local incident ledger)")
+_d("recovery_slo", str, "collective.detect<15,serve<1",
+   "declarative recovery SLO bars checked when an incident closes: "
+   "comma-separated 'subsystem[.phase]<seconds' entries; an incident "
+   "exceeding a matching bar closes with slo=fail")
+
 # --- Memory monitor ---
 _d("memory_monitor_refresh_ms", int, 1000, "node memory pressure check period; 0 disables")
 _d("memory_usage_threshold", float, 0.95, "kill a retriable worker above this node memory fraction")

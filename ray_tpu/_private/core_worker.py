@@ -39,7 +39,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
-from ray_tpu._private import fault_injection, rpc
+from ray_tpu._private import fault_injection, flight_recorder, incidents, rpc
 from ray_tpu._private.config import RayConfig
 from ray_tpu._private.ids import (ACTOR_ID_UNIQUE_BYTES, ActorID, JobID,
                                   NodeID, ObjectID, TaskID, WorkerID,
@@ -155,6 +155,9 @@ class CoreWorker:
         self._release_queue: deque = deque()
         self._release_scheduled = False
         self.session_dir = session_dir
+        # Crash-surviving black box: hot paths append into an mmap'd ring
+        # in the session dir; the nodelet harvests it if this process dies.
+        flight_recorder.init_process(session_dir, self._worker_id_hex)
         self.namespace = namespace
         self.job_id = JobID.from_int(0)
         self.ctx = get_serialization_context()
@@ -2233,6 +2236,9 @@ class CoreWorker:
         self.task_ctx.task_name = spec.name
         self.task_ctx.attempt_number = spec.attempt_number
         self._track_task_start(spec, threading.get_ident())
+        if flight_recorder.RECORDING:
+            flight_recorder.record(
+                "task.start", f"{spec.name}#a{spec.attempt_number}")
         trace_token = _trace_ctx.set((spec.trace_id, spec.span_id))
         if self.job_id.int_value() == 0:
             self.job_id = spec.job_id
@@ -2269,6 +2275,8 @@ class CoreWorker:
         finally:
             self.task_ctx.task_id = None
             self._track_task_end(spec)
+            if flight_recorder.RECORDING:
+                flight_recorder.record("task.end", spec.name)
             _trace_ctx.reset(trace_token)
 
     async def _invoke_async(self, spec: TaskSpec, method) -> dict:
@@ -2765,6 +2773,10 @@ class NormalTaskSubmitter:
         by that nodelet: mark them returned (so _pump and _push_one skip
         them) and re-pump each affected class so queued work re-leases on a
         surviving node."""
+        inc = incidents.open_incident(
+            "lease_cache", kind="nodelet_conn_lost", detail=conn.name)
+        inc.stamp("detect")
+        dropped = 0
         for addr, c in list(self.cw._nodelet_conns.items()):
             if c is conn:
                 self.cw._nodelet_conns.pop(addr, None)
@@ -2772,6 +2784,7 @@ class NormalTaskSubmitter:
             dead = [l for l in st["idle"] if l.get("nodelet_conn") is conn]
             if not dead:
                 continue
+            dropped += len(dead)
             for lease in dead:
                 lease["returned"] = True
             st["idle"] = [l for l in st["idle"]
@@ -2779,6 +2792,10 @@ class NormalTaskSubmitter:
             logger.info("dropped %d cached lease(s) from dead nodelet %s",
                         len(dead), conn.name)
             self._schedule_pump(key, st)
+        # quarantine = cache purged; pumps re-lease on surviving nodes
+        inc.stamp("quarantine")
+        inc.detail = f"{conn.name}|dropped={dropped}"
+        inc.close()
 
     async def _request_lease(self, key, st):
         import uuid
@@ -2943,8 +2960,11 @@ class NormalTaskSubmitter:
             lost_at = getattr(spec, "_lost_at", None)
             if lost_at is not None:
                 spec._lost_at = None
-                fault_injection.observe_recovery(
-                    "task_retry", time.monotonic() - lost_at)
+                # one-phase incident backdated to the loss: the retry's
+                # landing IS the restored service (emits recovery_seconds)
+                incidents.open_incident(
+                    "task_retry", kind="worker_died", detail=spec.name,
+                    started_mono=lost_at).close()
             self.cw._observe_phases(spec, item)
             self.cw.complete_task(spec, item["returns"], holds)
         elif item["status"] == "error":

@@ -196,11 +196,7 @@ def refresh() -> None:
             _m_injected = M.Counter(
                 "faults_injected_total",
                 "chaos-engine fault injections fired, by point and action")
-            _m_recovery = M.Histogram(
-                "recovery_seconds",
-                "time from a detected failure to restored service, by "
-                "subsystem (task retry landed, collective group rebuilt, "
-                "serve replica failed over)")
+            _recovery_metric()
 
 
 def hit(point: str, detail: str = "") -> Optional[str]:
@@ -241,6 +237,12 @@ def hit(point: str, detail: str = "") -> Optional[str]:
 def _record(rec: str, point: str, action: str) -> None:
     if _m_injected is not None:
         _m_injected.inc(1, {"point": point, "action": action})
+    from ray_tpu._private import flight_recorder
+
+    if flight_recorder.RECORDING:
+        # a kill action's own record is often the victim's LAST black-box
+        # entry: exactly what a post-mortem wants on top of the ring
+        flight_recorder.record("chaos.hit", rec)
     try:
         path = RayConfig.chaos_trace_file
     except Exception:
@@ -282,10 +284,10 @@ def delay_s() -> float:
     return RayConfig.chaos_delay_ms / 1000.0
 
 
-def observe_recovery(subsystem: str, seconds: float) -> None:
-    """Record a detected-failure -> restored-service interval.  Rides the
-    chaos metrics but is live whenever any recovery path runs (the metric
-    registers on first use even with chaos disabled)."""
+def _recovery_metric():
+    """The one place the recovery_seconds histogram is built (refresh()
+    and the incident layer both route through here, so the description and
+    identity cannot drift)."""
     global _m_recovery
     if _m_recovery is None:
         from ray_tpu._private import metrics as M
@@ -295,7 +297,16 @@ def observe_recovery(subsystem: str, seconds: float) -> None:
             "time from a detected failure to restored service, by "
             "subsystem (task retry landed, collective group rebuilt, "
             "serve replica failed over)")
-    _m_recovery.observe(seconds, {"subsystem": subsystem})
+    return _m_recovery
+
+
+def observe_recovery(subsystem: str, seconds: float) -> None:
+    """Record a detected-failure -> restored-service interval.  Delegates
+    to the incident layer (a pre-timed single-phase incident), which is the
+    sole emitter of recovery_seconds — one ledger, no drift."""
+    from ray_tpu._private import incidents
+
+    incidents.observe(subsystem, seconds)
 
 
 def describe_points() -> List[Tuple[str, str, str, str]]:

@@ -171,6 +171,11 @@ class GcsServer:
         self._submitted: Dict[str, dict] = {}  # submission_id -> {rec, proc}
         self.placement_groups: Dict[PlacementGroupID, Any] = {}  # filled by pg_manager
         self.task_events: deque = deque(maxlen=RayConfig.task_events_max_buffer_size)
+        # Observability ledgers: harvested dead-worker black boxes (keyed by
+        # worker_id hex, insertion-ordered for retention eviction) + closed
+        # failure incidents reported by every process in the cluster.
+        self.blackboxes: Dict[str, dict] = {}
+        self.incidents: deque = deque(maxlen=max(RayConfig.incident_retention, 1))
         self.server = rpc.Server(self._handlers(), name="gcs")
         self.server.on_disconnect = self._on_disconnect
         self._started = asyncio.Event()
@@ -879,8 +884,11 @@ class GcsServer:
                 self.named_actors.pop((info.namespace, info.name), None)
 
     async def rpc_worker_died(self, conn, msg):
-        """Nodelet reports a worker process exit; fail any actor bound to it."""
+        """Nodelet reports a worker process exit; fail any actor bound to it.
+        The report may carry the victim's harvested black box (its flight
+        recorder's last records), archived for `state.get_blackbox`."""
         wid = msg["worker_id"]
+        self._store_blackbox(msg.get("blackbox"))
         for info in list(self.actors.values()):
             if info.worker_id == wid and info.state in ("ALIVE", "PENDING_CREATION"):
                 await self._handle_actor_failure(
@@ -888,6 +896,69 @@ class GcsServer:
                 )
         await self._drop_holder_everywhere(wid)
         return True
+
+    def _store_blackbox(self, bb) -> None:
+        if not bb or not bb.get("worker_id"):
+            return
+        self.blackboxes[bb["worker_id"]] = bb
+        keep = max(RayConfig.incident_retention, 1)
+        while len(self.blackboxes) > keep:  # evict oldest harvest
+            self.blackboxes.pop(next(iter(self.blackboxes)))
+
+    async def rpc_blackbox_harvest(self, conn, msg):
+        """Archive a harvested ring for a death that had no worker_died
+        report (idle worker reaped, surplus pool shrink)."""
+        self._store_blackbox(msg.get("blackbox"))
+        return True
+
+    async def rpc_get_blackbox(self, conn, msg):
+        """Harvested black boxes by worker_id hex (prefix ok) or node_id
+        hex (prefix ok, every harvest from that node); both None = all."""
+        wid = msg.get("worker_id")
+        nid = msg.get("node_id")
+        out = []
+        for bb in self.blackboxes.values():
+            if wid is not None and not bb["worker_id"].startswith(wid):
+                continue
+            if nid is not None and not bb.get("node_id", "").startswith(nid):
+                continue
+            out.append(bb)
+        return out
+
+    async def rpc_incident_report(self, conn, msg):
+        """A process closed a failure incident.  Join it against the
+        harvested black boxes: an explicit victim worker id wins; otherwise
+        a harvest from inside the incident's open..close window (the usual
+        case for a collective rank kill, where survivors know the dead
+        *rank* but not its worker id) rides along flagged as a time match."""
+        if msg.get("blackbox") is None:
+            bb = self.blackboxes.get(msg.get("victim") or "")
+            if bb is None:
+                lo = msg.get("opened_at", 0.0) - 1.0
+                hi = msg.get("closed_at", 0.0) + 1.0
+                for cand in reversed(list(self.blackboxes.values())):
+                    if lo <= cand.get("harvested_at", 0.0) <= hi:
+                        bb = dict(cand)
+                        bb["victim_match"] = "time_window"
+                        break
+            if bb is not None:
+                msg["blackbox"] = bb
+        self.incidents.append(msg)
+        return True
+
+    async def rpc_list_incidents(self, conn, msg):
+        """Closed incidents, newest first; filterable by subsystem."""
+        msg = msg or {}
+        limit = msg.get("limit", 1000)
+        subsystem = msg.get("subsystem")
+        out = []
+        for rec in reversed(self.incidents):
+            if subsystem is not None and rec.get("subsystem") != subsystem:
+                continue
+            out.append(rec)
+            if len(out) >= limit:
+                break
+        return out
 
     async def rpc_actor_holder_update(self, conn, msg):
         info = self.actors.get(ActorID(msg["actor_id"]))

@@ -33,7 +33,7 @@ from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Set, Tuple
 
-from ray_tpu._private import fault_injection, rpc
+from ray_tpu._private import fault_injection, flight_recorder, incidents, rpc
 from ray_tpu._private.config import RayConfig
 from ray_tpu._private.ids import NodeID, ObjectID, WorkerID
 from ray_tpu._private.object_store import PlasmaStore, register_store_handlers
@@ -169,6 +169,11 @@ class Nodelet:
     # ------------------------------------------------------------------ boot
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
         self.addr = await self.server.start(host, port)
+        # This process's own black box + incident publisher (the nodelet has
+        # no core worker, so incidents ride its GCS connection instead)
+        flight_recorder.init_process(self.session_dir,
+                                     f"nodelet-{self.node_id.hex()}")
+        incidents.set_publisher(self._publish_incident)
         # Prometheus scrape endpoint for this node's merged metrics
         # (reference: the per-node metrics agent, _private/metrics_agent.py:483)
         from ray_tpu._private.metrics import default_registry, serve_metrics_http
@@ -1080,15 +1085,64 @@ class Nodelet:
             self._fulfill_pops()
         if w.lease_id is not None:
             self._release_lease(w.lease_id)
+        # Post-mortem harvest BEFORE reporting: the death notify carries the
+        # victim's last recorded moments so the GCS can serve them with the
+        # failure instead of them dying with the process.
+        blackbox = self._harvest_blackbox(w.worker_id, reason)
         if report and (w.is_actor or prev_state != "idle"):
             try:
                 await self.gcs.notify("worker_died", {
                     "worker_id": w.worker_id,
                     "node_id": self.node_id.binary(),
                     "reason": f"worker process died: {reason}",
+                    "blackbox": blackbox,
                 })
             except ConnectionError:
                 pass
+        elif blackbox is not None:
+            # unreported deaths (idle worker reaped) still archive the ring
+            try:
+                await self.gcs.notify("blackbox_harvest", {
+                    "worker_id": w.worker_id,
+                    "node_id": self.node_id.binary(),
+                    "blackbox": blackbox,
+                })
+            except ConnectionError:
+                pass
+
+    def _harvest_blackbox(self, worker_id: bytes, reason: str):
+        """Read the dead worker's crash-surviving flight-recorder ring out
+        of the session dir (the kernel kept the mmap'd pages; SIGKILL could
+        not take them), then unlink it — one harvest per death."""
+        path = flight_recorder.ring_path(self.session_dir, worker_id.hex())
+        records = flight_recorder.harvest(path, limit=200)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        if not records:
+            return None
+        if flight_recorder.RECORDING:
+            flight_recorder.record(
+                "blackbox.harvest",
+                f"{worker_id.hex()[:12]}|{len(records)} records")
+        return {
+            "worker_id": worker_id.hex(),
+            "node_id": self.node_id.hex(),
+            "harvested_at": time.time(),
+            "reason": reason,
+            "records": records,
+        }
+
+    def _publish_incident(self, rec: dict) -> None:
+        gcs = self.gcs
+        if gcs is None or gcs.closed:
+            return
+        try:
+            asyncio.get_running_loop().create_task(
+                gcs.notify("incident_report", rec))
+        except RuntimeError:
+            pass  # off-loop close: the local ledger keeps the record
 
     def _kill_worker_proc(self, w: WorkerHandle):
         if w.proc is not None and w.proc.poll() is None:
@@ -1402,6 +1456,10 @@ class Nodelet:
         # cached leases must not strand healthy workers in "leased")
         conn.context.setdefault("granted_leases", set()).add(lease_id)
         self._observe_lease_phases(t_req, t_acquired, time.monotonic())
+        if flight_recorder.RECORDING:
+            flight_recorder.record(
+                "lease.grant",
+                f"id={lease_id}|worker={w.worker_id.hex()[:12]}")
         return {"type": "granted", "lease_id": lease_id,
                 "worker_addr": list(w.addr), "worker_id": w.worker_id}
 

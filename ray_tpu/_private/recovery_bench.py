@@ -87,10 +87,15 @@ def run_recovery_bench() -> dict:
                 detect_s = time.perf_counter() - t0
             t1 = time.perf_counter()
             ccore._groups[name].rebuild(timeout_s=60)
+            rebuild_s = time.perf_counter() - t1
             col.allreduce(data, group_name=name, timeout_s=60)
+            # the rebuild closed this rank's incident: its per-phase
+            # timeline + SLO verdict become BENCH columns
+            incident = ccore._groups[name].last_incident
             col.destroy_collective_group(name)
             return {"detect_s": detect_s,
-                    "rebuild_s": time.perf_counter() - t1}
+                    "rebuild_s": rebuild_s,
+                    "incident": incident}
 
     actors = [_Rank.remote() for _ in range(4)]
     refs = [a.run.remote(r, 4, "recovery-bench", 3,
@@ -106,10 +111,25 @@ def run_recovery_bench() -> dict:
             ray_tpu.kill(a)
         except Exception:
             pass
-    out["rank_kill_allreduce_w4"] = {
+    row = {
         "detect_ms": round(
             max(s["detect_s"] for s in survivors) * 1e3, 2),
         "rebuild_ms": round(
             max(s["rebuild_s"] for s in survivors) * 1e3, 2),
     }
+    # incident-phase columns (worst survivor per phase) + the SLO verdict:
+    # any failing survivor fails the row
+    phase_ms: dict = {}
+    slo = "none"
+    for s in survivors:
+        inc = s.get("incident") or {}
+        for pname, sec in inc.get("phases", []):
+            phase_ms[pname] = max(phase_ms.get(pname, 0.0), sec * 1e3)
+        verdict = inc.get("slo", "none")
+        if verdict == "fail" or (verdict == "pass" and slo == "none"):
+            slo = verdict
+    for pname, ms in phase_ms.items():
+        row[f"phase_{pname}_ms"] = round(ms, 2)
+    row["slo"] = slo
+    out["rank_kill_allreduce_w4"] = row
     return out

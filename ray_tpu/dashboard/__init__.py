@@ -152,7 +152,7 @@ function rate(vals, interval) {
 async function load() {
   try {
     const [nodes, metrics, actors, jobs, status, tasks, summary, history,
-           serveV, dataV, trainV, llmV, hangs] =
+           serveV, dataV, trainV, llmV, hangs, incidents] =
       await Promise.all([
         fetch('/api/nodes').then(r => r.json()),
         fetch('/api/node_metrics').then(r => r.json()),
@@ -167,6 +167,7 @@ async function load() {
         fetch('/api/train').then(r => r.json()),
         fetch('/api/llm').then(r => r.json()),
         fetch('/api/hangs').then(r => r.json()),
+        fetch('/api/incidents?limit=20').then(r => r.json()),
       ]);
     let html = '<h2>Nodes</h2><table><tr><th>node</th><th>name</th>' +
       '<th>alive</th><th>CPU</th><th>mem</th><th>object store</th>' +
@@ -313,6 +314,31 @@ async function load() {
         if (h.stack)
           html += '<tr><td colspan="5"><details><summary>stack at flag ' +
             `time</summary><pre>${esc(h.stack)}</pre></details></td></tr>`;
+      }
+      html += '</table>';
+    }
+    if (incidents.length) {
+      html += '<h2>Incidents</h2><table><tr><th>when</th>' +
+        '<th>subsystem</th><th>kind</th><th>recovery</th><th>phases</th>' +
+        '<th>SLO</th><th>black box</th></tr>';
+      for (const i of incidents) {
+        const when = new Date(i.opened_at * 1000).toLocaleTimeString();
+        const phases = (i.phases || []).map(
+          ([n, s]) => `${n}=${(s * 1000).toFixed(1)}ms`).join(' ');
+        const slo = i.slo === 'fail'
+          ? '<span style="color:#b00">fail</span>'
+          : esc(i.slo || 'none');
+        let bb = '';
+        if (i.blackbox) {
+          const tail = (i.blackbox.records || []).slice(-12).map(
+            r => `#${r.seq} ${r.kind} ${r.detail}`).join('\n');
+          bb = `<details><summary>${i.blackbox.records.length} records` +
+            `</summary><pre>${esc(tail)}</pre></details>`;
+        }
+        html += `<tr><td>${when}</td><td>${esc(i.subsystem)}</td>` +
+          `<td>${esc(i.kind || '')}${i.ok ? '' : ' (unrecovered)'}</td>` +
+          `<td>${(i.recovery_seconds * 1000).toFixed(1)}ms</td>` +
+          `<td>${esc(phases)}</td><td>${slo}</td><td>${bb}</td></tr>`;
       }
       html += '</table>';
     }
@@ -596,6 +622,16 @@ class Dashboard:
                 "node_id": request.query.get("node_id"),
                 "task_id": request.query.get("task_id")})
 
+        def blackbox(request):
+            return self._call("get_blackbox", {
+                "worker_id": request.query.get("worker_id"),
+                "node_id": request.query.get("node_id")})
+
+        def incidents(request):
+            return self._call("list_incidents", {
+                "subsystem": request.query.get("subsystem"),
+                "limit": int(request.query.get("limit", 100))})
+
         def history_sample():
             """One ring-buffer sample: per-node utilization + task-state
             counts + compact library series (blocking; runs on an executor
@@ -672,6 +708,8 @@ class Dashboard:
         app.router.add_get("/api/task_summary", offload(task_summary))
         app.router.add_get("/api/hangs", offload(hangs))
         app.router.add_get("/api/stacks", offload(stacks))
+        app.router.add_get("/api/blackbox", offload(blackbox))
+        app.router.add_get("/api/incidents", offload(incidents))
         app.router.add_get("/api/history", offload(history))
         app.router.add_get("/api/serve", offload(serve_view))
         app.router.add_get("/api/data", offload(data_view))

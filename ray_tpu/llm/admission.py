@@ -114,6 +114,10 @@ class AdmissionController:
 
     def _shed(self, reason: str, retry_after_s: float) -> RequestShed:
         self.shed[reason] = self.shed.get(reason, 0) + 1
+        from ray_tpu._private import flight_recorder
+
+        if flight_recorder.RECORDING:
+            flight_recorder.record("admission.shed", reason)
         return RequestShed(reason, max(retry_after_s, 0.1))
 
     async def admit(self, tenant: str = DEFAULT_TENANT) -> float:

@@ -405,6 +405,69 @@ def _cmd_stack(args) -> int:
     return 0
 
 
+def _cmd_blackbox(args) -> int:
+    """Harvested flight-recorder rings of dead workers: the last records a
+    SIGKILL'd process wrote into its crash-surviving mmap'd ring before it
+    died (the nodelet reads the ring off disk at death and ships the tail
+    to the GCS)."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    address = _resolve_address(args.address)
+    ray_tpu.init(address=address, ignore_reinit_error=True)
+    boxes = state.get_blackbox(worker_id=args.worker_id, node_id=args.node)
+    if not boxes:
+        print("no harvested black boxes (no worker deaths, or the flight "
+              "recorder is disabled: flight_recorder_bytes=0)")
+        return 1
+    for bb in boxes:
+        when = time.strftime("%H:%M:%S", time.localtime(bb["harvested_at"]))
+        print(f"==== worker {bb['worker_id'][:12]} on node "
+              f"{bb.get('node_id', '?')[:12]} (harvested {when}; "
+              f"{bb.get('reason', '?')}) ====")
+        records = bb.get("records", [])
+        for r in records[-args.tail:]:
+            ts = time.strftime("%H:%M:%S", time.localtime(r["ts"]))
+            frac = f"{r['ts'] % 1:.3f}"[1:]
+            print(f"  #{r['seq']:<6} {ts}{frac}  {r['kind']:<16} "
+                  f"{r['detail']}")
+        print()
+    return 0
+
+
+def _cmd_incidents(args) -> int:
+    """Closed failure incidents: one line per incident with its per-phase
+    recovery timeline and SLO verdict (detect -> quarantine -> rebuild ->
+    restore -> resume, durations summing to recovery_seconds)."""
+    import ray_tpu
+    from ray_tpu.util import state
+
+    address = _resolve_address(args.address)
+    ray_tpu.init(address=address, ignore_reinit_error=True)
+    rows = state.list_incidents(subsystem=args.subsystem, limit=args.limit)
+    if not rows:
+        print("no incidents recorded")
+        return 0
+    for rec in rows:
+        when = time.strftime("%H:%M:%S", time.localtime(rec["opened_at"]))
+        phases = " ".join(f"{n}={s * 1000:.1f}ms"
+                          for n, s in rec.get("phases", []))
+        slo = rec.get("slo", "none")
+        ok = "recovered" if rec.get("ok") else "UNRECOVERED"
+        print(f"{when}  {rec['subsystem']:<12} {rec.get('kind', ''):<22} "
+              f"{rec['recovery_seconds'] * 1000:8.1f}ms  slo={slo:<5} "
+              f"{ok}  [{phases}]  {rec.get('detail', '')}")
+        if args.verbose and rec.get("blackbox"):
+            bb = rec["blackbox"]
+            match = bb.get("victim_match", "worker_id")
+            print(f"    blackbox: worker {bb['worker_id'][:12]} "
+                  f"({len(bb.get('records', []))} records, "
+                  f"matched by {match}); last:")
+            for r in bb.get("records", [])[-8:]:
+                print(f"      #{r['seq']:<6} {r['kind']:<16} {r['detail']}")
+    return 0
+
+
 def _cmd_logs(args) -> int:
     """List/tail log files across the cluster (reference:
     python/ray/_private/log_monitor.py + `ray logs` in scripts.py).
@@ -669,6 +732,31 @@ def main(argv=None) -> int:
                    help="node id (hex prefix ok); default: every node")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=_cmd_stack)
+
+    p = sub.add_parser("blackbox",
+                       help="harvested flight-recorder rings of dead "
+                            "workers (their last recorded moments)")
+    p.add_argument("worker_id", nargs="?", default=None,
+                   help="worker id (hex prefix ok); default: every harvest")
+    p.add_argument("--node", default=None,
+                   help="node id (hex prefix ok): harvests from one node")
+    p.add_argument("--tail", type=int, default=50,
+                   help="records shown per black box (newest)")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=_cmd_blackbox)
+
+    p = sub.add_parser("incidents",
+                       help="closed failure incidents with per-phase "
+                            "recovery timelines and SLO verdicts")
+    p.add_argument("--subsystem", default=None,
+                   help="filter (collective, serve, pipeline, task_retry, "
+                        "lease_cache)")
+    p.add_argument("--limit", type=int, default=50)
+    p.add_argument("--verbose", action="store_true",
+                   help="also print each incident's harvested black-box "
+                        "tail")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=_cmd_incidents)
 
     p = sub.add_parser("memory",
                        help="per-node object-store usage + spill counters")

@@ -236,7 +236,6 @@ class ProxyActor:
         error`` SSE frame instead of hanging or replaying."""
         from aiohttp import web
 
-        from ray_tpu._private import fault_injection
         from ray_tpu.exceptions import RayActorError
         from ray_tpu.serve._streaming import ResponseStream
 
@@ -260,13 +259,20 @@ class ProxyActor:
                     if wrote_chunk or retried or retry is None:
                         raise  # -> terminal error event below
                     retried = True
-                    t_fail = time.perf_counter()
+                    from ray_tpu._private import incidents
+
+                    inc = incidents.open_incident(
+                        "serve", kind="replica_failover",
+                        detail=request.path)
+                    inc.stamp("detect")
                     out = await loop.run_in_executor(None, retry)
                     if not isinstance(out, ResponseStream):
+                        inc.close(ok=False)
                         raise  # app no longer streams: can't splice it in
                     stream = out
-                    fault_injection.observe_recovery(
-                        "serve", time.perf_counter() - t_fail)
+                    # re-issued on a fresh replica: stream restored
+                    inc.stamp("restore")
+                    inc.close()
                     continue
                 for item in items:
                     if isinstance(item, bytes):

@@ -24,12 +24,27 @@ import pickle
 import time
 from typing import Any, Callable, Dict, Optional
 
+from ray_tpu._private import flight_recorder, incidents
 from ray_tpu.exceptions import CollectiveTimeout, PipelineStageDied
 from ray_tpu.experimental.channel import ChannelClosed
 
 _KV_NS = "_pipe"
 _PROBE_INTERVAL_S = 0.25
 DEFAULT_TIMEOUT_S = 60.0
+
+
+def _stage_died(msg: str, stage: int, op: str) -> PipelineStageDied:
+    """Build the error AND ledger it: a dead stage is an incident (closed
+    unrecovered — in-repo pipeline gangs fail the step rather than patch
+    the schedule) plus a black-box record naming the last op attempted."""
+    if flight_recorder.RECORDING:
+        flight_recorder.record("pipe.dead", f"stage{stage}|{op}")
+    inc = incidents.open_incident(
+        "pipeline", kind="PipelineStageDied",
+        detail=f"stage{stage}|{op}", victim=f"stage{stage}")
+    inc.stamp("detect")
+    inc.close(ok=False)
+    return PipelineStageDied(msg, stage=stage, op=op)
 
 
 def _kv(method: str, msg: dict):
@@ -130,13 +145,18 @@ class StageLink:
             return
         alive = self._peer_alive()
         if alive is False:
-            raise PipelineStageDied(
+            raise _stage_died(
                 f"pipeline stage {self.peer_stage} died during {op} "
                 f"(liveness probe: endpoint gone)",
                 stage=self.peer_stage, op=op)
 
     def send(self, tag: str, payload: Any,
              timeout_s: Optional[float] = None) -> None:
+        if flight_recorder.RECORDING:
+            # recorded at entry: the black box must show the op a crash
+            # INTERRUPTED, not only the ones that completed
+            flight_recorder.record(
+                "pipe.send", f"{tag}|stage{self.peer_stage}")
         deadline = time.monotonic() + (timeout_s if timeout_s is not None
                                        else self.timeout_s)
         while True:
@@ -154,6 +174,9 @@ class StageLink:
                 self._check_peer(f"send:{tag}")
 
     def recv(self, tag: str, timeout_s: Optional[float] = None) -> Any:
+        if flight_recorder.RECORDING:
+            flight_recorder.record(
+                "pipe.recv", f"{tag}|stage{self.peer_stage}")
         deadline = time.monotonic() + (timeout_s if timeout_s is not None
                                        else self.timeout_s)
         while True:
@@ -171,7 +194,7 @@ class StageLink:
                 self._check_peer(f"recv:{tag}")
                 continue
             except ChannelClosed:
-                raise PipelineStageDied(
+                raise _stage_died(
                     f"pipeline stage {self.peer_stage} closed its channel "
                     f"mid-schedule during recv:{tag}",
                     stage=self.peer_stage, op=f"recv:{tag}") from None
@@ -273,7 +296,7 @@ def _wait_kv(key: str, timeout_s: float, *, job: str, peer: int):
             return blob
         alive = stage_alive(job, peer, stale_after_s=timeout_s)
         if alive is False:
-            raise PipelineStageDied(
+            raise _stage_died(
                 f"pipeline stage {peer} died before opening its channel",
                 stage=peer, op="rendezvous")
         if time.monotonic() > deadline:

@@ -51,7 +51,7 @@ from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ray_tpu._private import fault_injection, rpc
+from ray_tpu._private import fault_injection, flight_recorder, incidents, rpc
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.config import RayConfig
 from ray_tpu.exceptions import (
@@ -185,6 +185,12 @@ class Group:
         self.last_quant_error = 0.0
         self._op_bytes = 0
         self._op_qerr = 0.0
+        # Incident bookkeeping: the op start the current failure interrupted
+        # (backdates the detect phase) + the open incident + the last closed
+        # record (the recovery bench reads its per-phase timeline from here).
+        self._op_started_at = 0.0
+        self._incident: Optional[incidents.Incident] = None
+        self.last_incident: Optional[dict] = None
         # Same-host shm chunk channel (lazy: first eligible bulk send).
         self._shm_tx: Optional[shm_ch.TxArena] = None
         self._shm_rx = shm_ch.RxCache()
@@ -300,6 +306,7 @@ class Group:
                 timeout=timeout)
         except (rpc.ConnectionLost, ConnectionError) as e:
             self._dead_ranks.add(rank)
+            self._note_dead("send", rank)
             raise CollectiveWorkerDied(
                 f"collective group {self.name!r}: blocking send to rank "
                 f"{rank} failed ({e!r}) — peer link severed; recover with "
@@ -316,6 +323,7 @@ class Group:
                 {"seq": seq, "src": self.rank, "tag": tag, "data": data})
         except (rpc.ConnectionLost, ConnectionError, OSError) as e:
             self._dead_ranks.add(rank)
+            self._note_dead("send", rank)
             raise CollectiveWorkerDied(
                 f"collective group {self.name!r}: send to rank {rank} "
                 f"failed ({e!r}) — peer link severed; recover with "
@@ -624,7 +632,25 @@ class Group:
         except OSError:
             return False
 
+    def _note_dead(self, op: str, rank: int) -> None:
+        """Every path that declares a peer dead funnels through here so
+        exactly one incident opens per failure, detect-stamped at the
+        moment of detection."""
+        if self._incident is None:
+            # backdate to the interrupted op's start: the detect phase then
+            # measures the real dead-peer detection latency, not zero
+            self._incident = incidents.open_incident(
+                "collective", kind="CollectiveWorkerDied",
+                detail=f"{self.name}|op={op}|seq={self.seq}",
+                victim=f"rank{rank}",
+                started_mono=self._op_started_at or None)
+            self._incident.stamp("detect")
+        if flight_recorder.RECORDING:
+            flight_recorder.record(
+                "col.dead", f"{self.name}|{op}|rank{rank}")
+
     def _dead_error(self, op: str, rank: int) -> CollectiveWorkerDied:
+        self._note_dead(op, rank)
         return CollectiveWorkerDied(
             f"collective {op!r} in group {self.name!r} (rank {self.rank}, "
             f"seq {self.seq}): rank {rank} DIED mid-collective (progress "
@@ -638,9 +664,17 @@ class Group:
         seq = self._next_seq(op)
         self._op_bytes = 0
         self._op_qerr = 0.0
+        self._op_started_at = time.monotonic()
+        if flight_recorder.RECORDING:
+            flight_recorder.record(
+                "col.op", f"{self.name}|{op}|seq={seq}")
         return seq
 
     def _finish_op(self, op: str, quant: Optional[str]) -> None:
+        if flight_recorder.RECORDING:
+            flight_recorder.record(
+                "col.op_end",
+                f"{self.name}|{op}|seq={self.seq}|bytes={self._op_bytes}")
         if self._op_bytes:
             self._m_bytes.inc(self._op_bytes,
                               {"group": self.name, "op": op})
@@ -1186,6 +1220,10 @@ class Group:
         self._comm_q = None
 
     def destroy(self):
+        if self._incident is not None:
+            # destroyed without a rebuild: the failure went unrecovered
+            self.last_incident = self._incident.close(ok=False)
+            self._incident = None
         self._stop_comm_thread()
         self.core.server.handlers.pop(self._handler_name, None)
         if self._shm_tx is not None:
@@ -1225,6 +1263,15 @@ class Group:
         group are bitwise-identical to a freshly initialized group of the
         same membership."""
         t0 = time.monotonic()
+        # Adopt the incident the failing op opened (detect already stamped);
+        # a proactive rebuild with no prior failure opens its own here.
+        inc = self._incident
+        if inc is None:
+            inc = incidents.open_incident(
+                "collective", kind="rebuild", detail=self.name,
+                started_mono=t0)
+        if flight_recorder.RECORDING:
+            flight_recorder.record("col.rebuild", self.name)
         if world_size is None or rank is None:
             survivors = [r for r in sorted(self._member_addrs)
                          if r == self.rank
@@ -1250,6 +1297,8 @@ class Group:
         self._last_probe.clear()
         self._member_addrs.clear()
         self._member_nodes.clear()
+        # survivors proven + dead incarnation fully torn down
+        inc.stamp("quarantine")
         # bring up the next generation
         self._gen += 1
         self.world_size = world_size
@@ -1296,8 +1345,15 @@ class Group:
         except Exception:
             pass
         self._register(timeout_s)
+        inc.stamp("rebuild")
         self._stamp_progress("rebuild", 0)
-        fault_injection.observe_recovery("collective", time.monotonic() - t0)
+        # close (implicit resume stamp) emits recovery_seconds{collective}
+        # plus the per-phase breakdown and the SLO verdict
+        self.last_incident = inc.close()
+        self._incident = None
+        if flight_recorder.RECORDING:
+            flight_recorder.record(
+                "col.rebuilt", f"{self.name}@g{self._gen}")
         return self
 
 

@@ -247,6 +247,35 @@ def summarize_hangs() -> List[Dict[str, Any]]:
     return out
 
 
+def get_blackbox(worker_id: Optional[str] = None,
+                 node_id: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Harvested flight-recorder rings of dead workers ("black boxes").
+
+    Each row is one dead process's last recorded moments — the nodelet read
+    the victim's crash-surviving mmap'd ring off disk at death and shipped
+    the tail to the GCS: ``{worker_id, node_id, harvested_at, reason,
+    records: [{seq, ts, kind, detail}, ...]}``.  Filter by ``worker_id`` or
+    ``node_id`` hex prefix; no filter returns every retained harvest.
+    """
+    return _gcs_call("get_blackbox",
+                     {"worker_id": worker_id, "node_id": node_id})
+
+
+def list_incidents(subsystem: Optional[str] = None,
+                   limit: int = 1000) -> List[Dict[str, Any]]:
+    """Closed failure incidents, newest first (the cluster-wide ledger).
+
+    Each row is one detected failure's recovery timeline: ``{id, subsystem,
+    kind, detail, victim, ok, opened_at, closed_at, recovery_seconds,
+    phases: [[name, seconds], ...], slo, slo_bars}`` — plus ``blackbox``
+    when the GCS could join the victim's harvested ring (explicit victim
+    worker id, or a harvest inside the incident's time window, flagged via
+    ``victim_match``).  Phase durations sum to ``recovery_seconds``.
+    """
+    return _gcs_call("list_incidents",
+                     {"subsystem": subsystem, "limit": limit})
+
+
 def _nodelet_call(node_id: Optional[str], method: str, msg=None):
     """RPC straight to one node's nodelet (address from the GCS node table).
     ``node_id=None`` targets the first alive node."""

@@ -18,12 +18,15 @@ task-event pipeline), so this module adds the two user-visible pieces:
 from __future__ import annotations
 
 import json
+import logging
 import time
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
 from ray_tpu._private.ids import _fast_unique
 from ray_tpu._private.worker import require_core
+
+logger = logging.getLogger(__name__)
 
 
 def get_current_trace_id() -> Optional[str]:
@@ -132,9 +135,14 @@ def _otlp_attr(key: str, value: Any) -> Dict[str, Any]:
 
 
 def export_otlp(filename: str, trace_id: Optional[str] = None,
-                service_name: str = "ray_tpu") -> int:
+                service_name: str = "ray_tpu",
+                limit: int = 100_000) -> int:
     """Write trace spans as OTLP/JSON (``resourceSpans``) and return the
-    span count.  ``trace_id=None`` exports every trace seen by the GCS.
+    span count.  ``trace_id=None`` exports every trace seen by the GCS;
+    ``limit`` caps the exported task rows (newest first — exceeding it
+    logs the dropped count rather than truncating silently).  Closed
+    failure incidents export too: one span per incident, a child span per
+    recovery phase.
 
     The output loads into any OTLP-ingesting backend (Jaeger, Tempo, an
     otel collector's file receiver) — the reference achieves the same by
@@ -155,7 +163,15 @@ def export_otlp(filename: str, trace_id: Optional[str] = None,
         except Exception:
             pass  # export proceeds on whatever has landed
 
-    rows = state.list_tasks(limit=100_000)
+    # fold everything, THEN apply the cap, so a hit limit can name exactly
+    # how many rows it dropped (no-silent-caps)
+    rows = state.list_tasks(limit=2 ** 31)
+    if len(rows) > limit:
+        logger.warning(
+            "export_otlp: %d task rows exceed limit=%d; dropping the %d "
+            "oldest (raise the limit= parameter to export them)",
+            len(rows), limit, len(rows) - limit)
+        rows = rows[-limit:]  # fold order is oldest-first
     spans: List[Dict[str, Any]] = []
     for row in rows:
         if row.get("trace_id") is None:
@@ -203,6 +219,7 @@ def export_otlp(filename: str, trace_id: Optional[str] = None,
         if events:
             span["events"] = events
         spans.append(span)
+    spans.extend(_incident_spans(trace_id))
     doc = {
         "resourceSpans": [{
             "resource": {"attributes": [
@@ -216,3 +233,59 @@ def export_otlp(filename: str, trace_id: Optional[str] = None,
     with open(filename, "w") as f:
         json.dump(doc, f)
     return len(spans)
+
+
+def _incident_spans(trace_id: Optional[str]) -> List[Dict[str, Any]]:
+    """Closed failure incidents as OTLP spans: one root span per incident
+    (trace id derived from the incident id, so each incident is its own
+    trace) with one child span per recovery phase — Jaeger/Tempo render the
+    detect/quarantine/rebuild/resume timeline as a waterfall."""
+    from ray_tpu.util import state
+
+    try:
+        recs = state.list_incidents()
+    except Exception:
+        return []  # no GCS (e.g. exporting before init): tasks only
+    spans: List[Dict[str, Any]] = []
+    for rec in recs:
+        inc_trace = (rec["id"] * 4)[:32]
+        if trace_id is not None and inc_trace != trace_id:
+            continue
+        end = rec.get("closed_at") or time.time()
+        start = end - rec.get("recovery_seconds", 0.0)
+        attrs = [
+            _otlp_attr("ray_tpu.incident_id", rec["id"]),
+            _otlp_attr("ray_tpu.subsystem", rec.get("subsystem", "?")),
+            _otlp_attr("ray_tpu.kind", rec.get("kind", "")),
+            _otlp_attr("ray_tpu.detail", rec.get("detail", "")),
+            _otlp_attr("ray_tpu.victim", rec.get("victim", "")),
+            _otlp_attr("ray_tpu.slo", rec.get("slo", "none")),
+            _otlp_attr("ray_tpu.recovered", bool(rec.get("ok"))),
+        ]
+        root_id = rec["id"][:16].ljust(16, "0")
+        spans.append({
+            "traceId": inc_trace,
+            "spanId": root_id,
+            "name": f"incident:{rec.get('subsystem', '?')}",
+            "kind": 1,
+            "startTimeUnixNano": str(int(start * 1e9)),
+            "endTimeUnixNano": str(int(end * 1e9)),
+            "attributes": attrs,
+            "status": ({"code": 1} if rec.get("ok")
+                       else {"code": 2, "message": "unrecovered"}),
+        })
+        t = start
+        for i, (phase, dur) in enumerate(rec.get("phases") or []):
+            spans.append({
+                "traceId": inc_trace,
+                "spanId": f"{i + 1:04x}" + root_id[4:],
+                "parentSpanId": root_id,
+                "name": f"phase.{phase}",
+                "kind": 1,
+                "startTimeUnixNano": str(int(t * 1e9)),
+                "endTimeUnixNano": str(int((t + dur) * 1e9)),
+                "attributes": [_otlp_attr("duration_s", dur)],
+                "status": {"code": 1},
+            })
+            t += dur
+    return spans
