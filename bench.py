@@ -427,6 +427,29 @@ def main() -> None:
         except Exception as e:
             result["flight_recorder"] = {"error": repr(e)}
 
+    # Continuous-profiler overhead guard (ISSUE 18): the sampler wakes at
+    # profile_hz per process and walks every thread's frames, so its cost
+    # must stay within noise at the canonical 19 Hz rate (and be exactly
+    # one attribute read when disabled — the shipped default).  Same
+    # interleaved A/B discipline as the flight recorder, one extra round:
+    # the measured per-tick fold cost is ~44 us (sub-1% of a core at
+    # 19 Hz), so any ratio drift past noise is a sampler regression.
+    if os.environ.get("RAY_TPU_BENCH_PROFILER", "1") != "0":
+        try:
+            on = off = None
+            for _ in range(3):
+                r_on = _noop_rate({"RAY_TPU_PROFILE_HZ": "19"})
+                r_off = _noop_rate({})  # profiler off: the shipped default
+                on = max(on or 0.0, r_on) if r_on else on
+                off = max(off or 0.0, r_off) if r_off else off
+            result["profiler"] = {
+                "tasks_sync_profiler_19hz": on,
+                "tasks_sync_profiler_off": off,
+                "ratio": round(on / off, 3) if on and off else None,
+            }
+        except Exception as e:
+            result["profiler"] = {"error": repr(e)}
+
     # LLM continuous-batching decode throughput (ISSUE 4): tiny model on
     # the numpy engine — in-process (no runtime), so the number isolates
     # scheduler+cache+runner cost.  Recorded on every platform; the engine
@@ -655,7 +678,7 @@ def main() -> None:
     # without seeing the difference in the row itself.
     for key in ("micro", "collective", "recovery", "pipeline", "train_3d",
                 "llm_decode_throughput", "watchdog_overhead",
-                "flight_recorder", "lint_tree", "serve_load"):
+                "flight_recorder", "profiler", "lint_tree", "serve_load"):
         if isinstance(result.get(key), dict):
             bench_rig.stamp(result[key], rig)
 

@@ -186,6 +186,17 @@ _d("hang_p95_floor_s", float, 5.0,
 _d("hang_min_samples", int, 5,
    "completed same-name tasks required before the p95 path applies")
 
+# --- Continuous profiler (_private/profiler.py) ---
+_d("profile_hz", float, 0.0,
+   "continuous-profiler sampling rate per process; 0 disables (the "
+   "default — disabled cost is one attribute read on the metrics-push "
+   "path); 19 Hz is the canonical enabled rate (prime, so it cannot "
+   "alias against periodic work); env re-read at sampler start so "
+   "subprocesses inherit RAY_TPU_PROFILE_HZ")
+_d("profile_max_stacks", int, 20_000,
+   "GCS-side cap on distinct aggregated profile stacks; lowest-count "
+   "entries evict first when exceeded")
+
 # --- Event loop / channels ---
 _d("loop_stall_threshold_s", float, 5.0,
    "warn (with the loop thread's stack) when the per-process IO event loop "

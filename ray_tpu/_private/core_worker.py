@@ -323,6 +323,21 @@ class CoreWorker:
         # without a driver push the phase breakdown never reaches the
         # nodelet's Prometheus scrape.
         self.io.spawn(self._push_metrics_loop())
+        # Continuous profiler (no-op unless profile_hz > 0): samples every
+        # thread in this process, tagging threads executing a task with the
+        # task's name via the running-task registry — pull-based, so the
+        # task hot path carries no profiling instrumentation at all.
+        from ray_tpu._private import profiler
+
+        profiler.ensure_started(self._profile_tags)
+
+    def _profile_tags(self, thread_ident: int) -> Optional[str]:
+        """Task name currently executing on ``thread_ident``, if any (the
+        profiler's sample-time tag source)."""
+        for rec in list(self._running_tasks.values()):
+            if rec.get("thread") == thread_ident:
+                return rec.get("name")
+        return None
 
     def _mark_cancelled_exec(self, tkey: bytes) -> None:
         """Record a cancelled-before-start marker, bounded to 4096 entries
@@ -448,6 +463,7 @@ class CoreWorker:
         """Push this worker's metrics (built-in + user-defined via
         ray_tpu.util.metrics) to the nodelet's scrape endpoint (reference:
         core worker -> per-node metrics agent)."""
+        from ray_tpu._private import profiler
         from ray_tpu._private.metrics import default_registry
 
         interval = RayConfig.metrics_report_interval_ms / 1000.0
@@ -455,9 +471,16 @@ class CoreWorker:
         while not self._shut:
             await asyncio.sleep(interval)
             try:
-                self.nodelet_conn.notify_coalesced("metrics_push", {
+                msg = {
                     "source": source,
-                    "snapshot": default_registry.snapshot()})
+                    "snapshot": default_registry.snapshot()}
+                # one attribute read when profiling is off — the profiler's
+                # entire disabled-state cost on this path
+                if profiler.SAMPLING:
+                    delta = profiler.take_delta()
+                    if delta:
+                        msg["profile"] = delta
+                self.nodelet_conn.notify_coalesced("metrics_push", msg)
             except (ConnectionError, rpc.ConnectionLost):
                 pass
 

@@ -176,6 +176,10 @@ class GcsServer:
         # failure incidents reported by every process in the cluster.
         self.blackboxes: Dict[str, dict] = {}
         self.incidents: deque = deque(maxlen=max(RayConfig.incident_retention, 1))
+        # Cluster-wide continuous-profiler aggregate: one entry per distinct
+        # (node, task, subsystem, tag, stack), bounded by profile_max_stacks
+        # with lowest-count-first eviction (rare stacks go before hot ones).
+        self.profile: Dict[Tuple[str, str, str, str, str], int] = {}
         self.server = rpc.Server(self._handlers(), name="gcs")
         self.server.on_disconnect = self._on_disconnect
         self._started = asyncio.Event()
@@ -1102,6 +1106,43 @@ class GcsServer:
 
         dumps = await asyncio.gather(*(one(i) for i in targets))
         return [d for d in dumps if d is not None]
+
+    async def rpc_profile_push(self, conn, msg):
+        """A nodelet relays profiler deltas (its own threads' and its
+        workers', piggybacked on the metrics push): merge into the bounded
+        cluster-wide aggregate."""
+        node = msg.get("node_id") or "?"
+        for entry in msg.get("entries", ()):
+            task, subsystem, stack, count = entry[:4]
+            tag = entry[4] if len(entry) > 4 else ""
+            key = (node, task or "", subsystem or "user", tag or "", stack)
+            self.profile[key] = self.profile.get(key, 0) + int(count)
+        cap = RayConfig.profile_max_stacks
+        if len(self.profile) > cap:
+            # evict the coldest stacks first — the flamegraph's wide frames
+            # (the answer to "where did the time go") survive
+            for key, _n in sorted(self.profile.items(),
+                                  key=lambda kv: kv[1])[:len(self.profile)
+                                                        - cap]:
+                del self.profile[key]
+        return True
+
+    async def rpc_get_profile(self, conn, msg):
+        """The cluster profile aggregate, optionally filtered by node /
+        task-name prefix, as ``[[node, task, subsystem, tag, stack, count],
+        ...]`` — the flamegraph CLI's and dashboard's read path."""
+        msg = msg or {}
+        node_hex = msg.get("node_id")
+        task_name = msg.get("task_name")
+        out = []
+        for (node, task, subsystem, tag, stack), count in \
+                self.profile.items():
+            if node_hex is not None and not node.startswith(node_hex):
+                continue
+            if task_name is not None and task != task_name:
+                continue
+            out.append([node, task, subsystem, tag, stack, count])
+        return out
 
     async def rpc_get_task_events(self, conn, msg):
         limit = msg.get("limit", 1000)

@@ -191,6 +191,11 @@ class Nodelet:
         self._bg.append(asyncio.get_event_loop().create_task(self._flush_dir_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._fs_monitor_loop()))
         self._bg.append(asyncio.get_event_loop().create_task(self._hang_watchdog_loop()))
+        # The nodelet's own threads join the cluster flamegraph too (no-op
+        # unless profile_hz > 0); its deltas ship via _report_loop's push.
+        from ray_tpu._private import profiler
+
+        profiler.ensure_started()
         logger.info("nodelet %s on %s:%s resources=%s",
                     self.node_id.hex()[:8], *self.addr, self.resources_total)
         return self.addr
@@ -356,6 +361,14 @@ class Nodelet:
                 if fingerprint != last_fingerprint:
                     view_version += 1
                     last_fingerprint = fingerprint
+                from ray_tpu._private import profiler
+
+                if profiler.SAMPLING:
+                    delta = profiler.take_delta()
+                    if delta:
+                        await self.gcs.notify("profile_push", {
+                            "node_id": self.node_id.hex(),
+                            "entries": delta})
                 resp = await self.gcs.call("resource_report", {
                     "node_id": self.node_id.binary(),
                     "available": self.resources_available,
@@ -429,6 +442,15 @@ class Nodelet:
         """A worker pushes its metric snapshot for this node's scrape
         endpoint (reference: core-worker -> metrics agent export)."""
         self.metrics_registry.merge_pushed(msg["source"], msg["snapshot"])
+        profile = msg.get("profile")
+        if profile:
+            # piggybacked profiler delta: forward to the GCS aggregate (the
+            # nodelet only relays — cluster-wide merging happens once)
+            try:
+                await self.gcs.notify("profile_push", {
+                    "node_id": self.node_id.hex(), "entries": profile})
+            except (ConnectionError, rpc.ConnectionLost):
+                pass  # observability must never fail the push path
         return True
 
     async def rpc_get_metrics_text(self, conn, msg):
@@ -664,6 +686,21 @@ class Nodelet:
                 await self.gcs.notify("add_task_events", {"events": events})
             except ConnectionError:
                 pass
+            # One-shot hung stacks join the cluster flamegraph too (tagged
+            # 'hung' at render time) — a hung task shows up in the profile
+            # even when continuous sampling is off, not only in /api/hangs.
+            from ray_tpu._private.profiler import fold_formatted_stack
+
+            entries = [
+                [ev["name"] or "", "core",
+                 fold_formatted_stack(ev["stack"]), 1, "hung"]
+                for ev in events if ev.get("stack")]
+            if entries:
+                try:
+                    await self.gcs.notify("profile_push", {
+                        "node_id": self.node_id.hex(), "entries": entries})
+                except ConnectionError:
+                    pass
 
     async def _task_stack(self, w: WorkerHandle, task_id: str):
         """One-shot stack dump of the worker, reduced to the executing

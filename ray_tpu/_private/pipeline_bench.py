@@ -188,6 +188,35 @@ def run_pipeline_bench() -> dict:
             "projected_speedup_overlap": round(sum(busy) / crit, 3),
             "stage_busy_s": [round(b, 4) for b in busy],
         })
+        # critical-path reconciliation (ISSUE 18): run the executors' last
+        # CPATH stamps through the same engine state.critical_path(step=)
+        # uses and check (a) bucket attribution sums to the path length and
+        # (b) the bubble share agrees with the BubbleClock's wall-clock
+        # measurement within 15 points
+        from ray_tpu._private import critical_path as cpath
+
+        stamps = [{"cpath": ex.last_cpath} for ex in (ex_a, ex_b)
+                  if ex.last_cpath is not None]
+        cp_row: dict = {"n_micro": m}
+        try:
+            res = cpath.train_step(stamps, stamps[0]["cpath"]["step"])
+            bucket_sum = sum(res["buckets"].values())
+            clock_bf = res["bubble_clock"]["bubble_s"] / max(
+                res["bubble_clock"]["step_wall_s"], 1e-9)
+            cp_row.update({
+                "critical_stage": res["critical_stage"],
+                "path_s": res["path_s"],
+                "bucket_sum_s": round(bucket_sum, 6),
+                "buckets_sum_to_path": abs(bucket_sum - res["path_s"])
+                <= max(1e-3, 0.01 * res["path_s"]),
+                "bubble_fraction_cpath": res["bubble_fraction"],
+                "bubble_fraction_clock": round(clock_bf, 4),
+                "bubble_within_15pts":
+                    abs(res["bubble_fraction"] - clock_bf) <= 0.15,
+            })
+        except (ValueError, IndexError, KeyError, ZeroDivisionError) as e:
+            cp_row["error"] = f"{type(e).__name__}: {e}"
+        out.setdefault("critical_path", []).append(cp_row)
         ex_a.close()
         ex_b.close()
     return out

@@ -70,6 +70,14 @@ def fold_task_events(events, limit: int = 1000,
             # dropped (buffer cap) the row must still carry a state
             row.setdefault("state", "RUNNING")
             continue
+        if ev["state"] == "CPATH":
+            # Critical-path annotation (train-step op intervals from a
+            # pipeline StageExecutor, or an LLM request's TTFT
+            # decomposition).  Pure payload carrier: the synthetic task_id
+            # never has lifecycle events, so default a terminal state.
+            row["cpath"] = ev.get("cpath")
+            row.setdefault("state", "FINISHED")
+            continue
         if ev["state"] == "PHASES":
             # Phase-breakdown annotation emitted by the driver when the
             # completion lands: merged into the row without disturbing the

@@ -37,6 +37,14 @@ aiohttp app serving
                               flag time)
     GET /api/stacks         — live Python stacks   (?node_id=...&task_id=...)
                               proxied GCS → nodelet → per-process sampler
+    GET /api/critical_path  — critical path of a trace / training step /
+                              LLM request (?trace_id= | ?step=[&experiment=]
+                              | ?request_id=): per-node % of path + bucket
+                              attribution
+    GET /api/flamegraph     — continuous-profiler aggregate as collapsed
+                              stacks (?node_id=...&task_name=...)
+    GET /flamegraph.svg     — the same aggregate as a self-contained SVG
+                              flamegraph
     GET /api/logs           — log files on a node   (?node_id=...)
     GET /api/log            — tail one log file     (?node_id=...&name=...)
 
@@ -342,6 +350,10 @@ async function load() {
       }
       html += '</table>';
     }
+    html += '<h2>Profiler</h2><p><a href="/flamegraph.svg" target="_blank">' +
+      'flamegraph (SVG)</a> · <a href="/api/flamegraph" target="_blank">' +
+      'collapsed stacks</a> · critical path: /api/critical_path?trace_id= ' +
+      '| ?step= | ?request_id=</p>';
     html += `<h2>Pending demand</h2><p>${esc(JSON.stringify(status.pending_demand))}</p>`;
     html += '<h2>Task summary</h2><table><tr><th>task</th><th>states</th></tr>';
     for (const [name, states] of Object.entries(summary))
@@ -682,6 +694,58 @@ class Dashboard:
                     return tuple(n["addr"])
             raise ValueError(f"no alive node {node_id_hex}")
 
+        def critical_path(request):
+            """Critical path of a trace / training step / LLM request —
+            the same engine the state API uses (critical_path.py is
+            dependency-free like taskfold), fed from this process's folded
+            task cache instead of the driver-side state API."""
+            from ray_tpu._private import critical_path as cp
+
+            rows = _folded_tasks()
+            trace = request.query.get("trace_id")
+            step = request.query.get("step")
+            rid = request.query.get("request_id")
+            if trace:
+                return cp.compute(rows, trace)
+            if step is not None:
+                return cp.train_step(rows, int(step),
+                                     request.query.get("experiment"))
+            if rid:
+                return cp.llm_request(rows, rid)
+            raise ValueError("need trace_id=, step= or request_id=")
+
+        def flamegraph(request):
+            """Cluster profile aggregate as collapsed-stack lines."""
+            from ray_tpu._private import profiler
+
+            raw = self._call("get_profile", {
+                "node_id": request.query.get("node_id"),
+                "task_name": request.query.get("task_name")})
+            entries = [[task, subsystem, stack, count, tag]
+                       for _node, task, subsystem, tag, stack, count in raw]
+            return {"collapsed": profiler.collapsed_lines(
+                entries, tag_hung=True)}
+
+        async def flamegraph_svg(request):
+            from ray_tpu._private import profiler
+
+            def build():
+                raw = self._call("get_profile", {
+                    "node_id": request.query.get("node_id"),
+                    "task_name": request.query.get("task_name")})
+                entries = [[task, subsystem, stack, count, tag]
+                           for _node, task, subsystem, tag, stack, count
+                           in raw]
+                return profiler.render_svg(
+                    profiler.collapsed_lines(entries, tag_hung=True))
+
+            try:
+                svg = await loop.run_in_executor(None, build)
+            except Exception as e:
+                return web.json_response(
+                    {"error": f"{type(e).__name__}: {e}"}, status=500)
+            return web.Response(text=svg, content_type="image/svg+xml")
+
         def logs(request):
             addr = _node_addr(request.query["node_id"])
             return self._nodelet_call(addr, "list_log_files")
@@ -715,6 +779,9 @@ class Dashboard:
         app.router.add_get("/api/data", offload(data_view))
         app.router.add_get("/api/train", offload(train_view))
         app.router.add_get("/api/llm", offload(llm_view))
+        app.router.add_get("/api/critical_path", offload(critical_path))
+        app.router.add_get("/api/flamegraph", offload(flamegraph))
+        app.router.add_get("/flamegraph.svg", flamegraph_svg)
         app.router.add_get("/api/logs", offload(logs))
         app.router.add_get("/api/log", offload(log_tail))
         runner = web.AppRunner(app, access_log=None)

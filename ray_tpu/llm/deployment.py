@@ -101,7 +101,10 @@ class LLMServer:
             raise
         self._metrics["queue_wait"].observe(wait_s, self._metric_labels)
         try:
-            rid = await self._engine.submit.remote(prompt, params)
+            # admission wait rides along so the engine's per-request TTFT
+            # decomposition starts at arrival, not at post-admission submit
+            rid = await self._engine.submit.remote(
+                prompt, params, admission_wait_s=wait_s)
         except BaseException:
             self._admission.release()
             raise

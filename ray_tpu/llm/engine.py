@@ -118,7 +118,8 @@ class EngineCore:
 
     # -------------------------------------------------------------- intake
     def submit(self, prompt: Union[str, Sequence[int]],
-               params: Union[SamplingParams, dict, None] = None) -> str:
+               params: Union[SamplingParams, dict, None] = None,
+               admission_wait_s: float = 0.0) -> str:
         if isinstance(params, dict):
             params = SamplingParams(**params)
         params = params or SamplingParams()
@@ -145,6 +146,11 @@ class EngineCore:
             self.ensure_adapter(params.adapter)
         rid = uuid.uuid4().hex[:12]
         req = Request(rid, prompt, params)
+        # admission-control queue wait (stamped by the serve deployment):
+        # the TTFT decomposition's first bucket — it happened BEFORE
+        # submitted_at, so extend the request's measured window back
+        req.admission_wait_s = max(float(admission_wait_s), 0.0)
+        req.submitted_at -= req.admission_wait_s
         with self._lock:
             if len(self._requests) > self._max_retained:
                 # bounded retention: evict the oldest terminal requests so a
@@ -216,7 +222,11 @@ class EngineCore:
                 self._out_cv.notify_all()
         # model math outside the lock: only this thread touches the cache
         for req, tokens, start in plan.prefills:
+            t0 = time.perf_counter()
             logits = self.runner.prefill(req.rid, tokens, start, self.cache)
+            # chunk execution interval for the TTFT decomposition — only
+            # the stepping thread writes it, so no lock needed
+            req.prefill_intervals.append((t0, time.perf_counter()))
             req.num_computed = start + len(tokens)
             if self.cache.config.enable_prefix_cache:
                 # index the now-committed full prompt pages so later
@@ -311,6 +321,7 @@ class EngineCore:
                 req.first_token_at = now
                 self._metrics["ttft"].observe(now - req.submitted_at,
                                               self._labels)
+                self._emit_cpath(req)
             elif req.last_token_at is not None:
                 gap = now - req.last_token_at
                 req.max_itl = max(req.max_itl, gap)
@@ -322,6 +333,81 @@ class EngineCore:
             elif req.params.stop and token in req.params.stop:
                 self.scheduler.finish(req, "stop")
             self._out_cv.notify_all()
+
+    def ttft_decomposition(self, rid: str) -> Dict[str, Any]:
+        """Where the request's time-to-first-token went: admission queue ->
+        scheduler queue (incl. post-preemption re-waits, shown separately)
+        -> prefill chunk execution.  The prefill intervals and preemption
+        gaps are disjoint sub-intervals of [submitted_at, first_token_at],
+        so the buckets sum to the measured TTFT exactly by construction."""
+        with self._lock:
+            req = self._requests.get(rid)
+            if req is None:
+                raise KeyError(f"unknown request {rid!r}")
+            if req.first_token_at is None:
+                raise ValueError(f"request {rid!r} has no first token yet")
+            return self._decompose(req)
+
+    def _decompose(self, req: Request) -> Dict[str, Any]:
+        first = req.first_token_at
+        total = first - req.submitted_at
+        admission = min(req.admission_wait_s, total)
+        chunks = [(s, min(e, first)) for s, e in req.prefill_intervals
+                  if s < first]
+        prefill_exec = sum(e - s for s, e in chunks)
+        # a preemption throws away computed state: the gap from eviction to
+        # the next prefill start is re-queue wait caused by the preemption
+        preempt_wait = 0.0
+        for pt in req.preempt_ts:
+            if pt >= first:
+                continue
+            restarts = [s for s, _e in chunks if s > pt]
+            preempt_wait += (min(restarts) if restarts else first) - pt
+        queue = max(total - admission - prefill_exec - preempt_wait, 0.0)
+        return {
+            "request_id": req.rid,
+            "ttft_s": round(total, 6),
+            "admission_wait_s": round(admission, 6),
+            "queue_s": round(queue, 6),
+            "prefill_exec_s": round(prefill_exec, 6),
+            "preempt_wait_s": round(preempt_wait, 6),
+            "chunks": len(chunks),
+            "preemptions": req.preemptions,
+        }
+
+    def _emit_cpath(self, req: Request) -> None:
+        """Stamp the finished TTFT decomposition on the task-event stream
+        (CPATH annotation) so state.critical_path(request_id=...) and the
+        dashboard read it cluster-wide.  No-op without a core worker (the
+        inline unit-test engines)."""
+        try:
+            from ray_tpu._private.config import RayConfig
+            from ray_tpu._private.worker import global_worker_core
+
+            core = global_worker_core()
+            if core is None or not RayConfig.task_events_enabled:
+                return
+            decomp = self._decompose(req)
+            core.emit_raw_event({
+                "task_id": f"cpath-llm-{req.rid}",
+                "attempt": 0,
+                "name": f"llm_request:{req.rid}",
+                "state": "CPATH",
+                "ts": time.time(),
+                "job_id": core.job_id.hex(),
+                "type": "ANNOTATION",
+                "node_id": core._node_id_hex,
+                "worker_id": core._worker_id_hex,
+                "cpath": {
+                    "kind": "llm_request",
+                    "rid": req.rid,
+                    "engine": self.name,
+                    "ttft_s": decomp["ttft_s"],
+                    "decomposition": decomp,
+                },
+            }, terminal=True)
+        except Exception:
+            pass  # observability must never fail token emission
 
     # --------------------------------------------------------------- read
     def next_output(self, rid: str, cursor: int = 0,
@@ -448,8 +534,13 @@ class InferenceEngine:
     def ping(self) -> bool:
         return True
 
-    def submit(self, prompt, params=None) -> str:
-        return self._core.submit(prompt, params)
+    def submit(self, prompt, params=None,
+               admission_wait_s: float = 0.0) -> str:
+        return self._core.submit(prompt, params,
+                                 admission_wait_s=admission_wait_s)
+
+    def ttft_decomposition(self, rid: str) -> Dict[str, Any]:
+        return self._core.ttft_decomposition(rid)
 
     def next_output(self, rid: str, cursor: int = 0,
                     timeout_s: float = 30.0) -> Dict[str, Any]:

@@ -88,6 +88,12 @@ class Request:
         self.finish_reason: Optional[str] = None
         self.error: Optional[str] = None
         self.preemptions = 0
+        # critical-path bookkeeping (TTFT decomposition): admission queue
+        # wait stamped by the server at submit, prefill chunk execution
+        # intervals stamped by the engine, preemption times stamped here
+        self.admission_wait_s = 0.0
+        self.prefill_intervals: List[Tuple[float, float]] = []
+        self.preempt_ts: List[float] = []
 
     @property
     def all_tokens(self) -> List[int]:
@@ -301,6 +307,7 @@ class Scheduler:
         req.num_computed = 0
         req.state = WAITING
         req.preemptions += 1
+        req.preempt_ts.append(time.perf_counter())
         self.preemptions += 1
         self.add(req)
         out.preempted.append(req)

@@ -393,6 +393,27 @@ def _cmd_stack(args) -> int:
         print(f"no stacks found for {where} (task finished, or no "
               f"matching node)")
         return 1
+    if getattr(args, "collapsed", False):
+        # point-in-time dump folded into the profiler's collapsed-stack
+        # universe: one line (count=1) per thread, task-tagged when the
+        # thread was executing a task
+        from ray_tpu._private.profiler import (collapsed_lines,
+                                               fold_formatted_stack)
+
+        entries = []
+        for node in dumps:
+            payloads = list(node.get("workers", []))
+            if node.get("nodelet"):
+                payloads.append(node["nodelet"])
+            for payload in payloads:
+                for t in payload.get("threads", []):
+                    stack = fold_formatted_stack(t.get("stack") or "")
+                    if stack:
+                        entries.append(
+                            [t.get("task_name") or "", "core", stack, 1])
+        for line in collapsed_lines(entries):
+            print(line)
+        return 0
     for node in dumps:
         nid = node.get("node_id")
         print(f"==== node {nid[:12] if nid else '<driver>'} ====")
@@ -402,6 +423,61 @@ def _cmd_stack(args) -> int:
         if node.get("nodelet"):
             print(format_stack_payload(node["nodelet"]))
             print()
+    return 0
+
+
+def _cmd_critical_path(args) -> int:
+    """Critical path of one trace / training step / LLM request: the
+    dependent chain that bounded the end-to-end wall, each node with its %
+    of the path and bucket attribution (queue, dispatch, exec,
+    object-transfer, collective-comm, pipeline-bubble, admission-wait)."""
+    import ray_tpu
+    from ray_tpu._private import critical_path as cp
+    from ray_tpu.util import state
+
+    address = _resolve_address(args.address)
+    ray_tpu.init(address=address, ignore_reinit_error=True)
+    try:
+        result = state.critical_path(
+            trace_id=args.trace, step=args.step,
+            request_id=args.request, experiment=args.experiment)
+    except ValueError as e:
+        print(f"critical-path: {e}")
+        return 1
+    if args.json:
+        print(cp.to_json(result))
+    else:
+        print(cp.render_tree(result))
+    return 0
+
+
+def _cmd_flamegraph(args) -> int:
+    """Cluster-wide flamegraph from the continuous profiler's aggregate:
+    collapsed-stack lines (flamegraph.pl / speedscope input) to stdout, or
+    a self-contained SVG with --svg.  Needs profile_hz > 0 somewhere
+    (RAY_TPU_PROFILE_HZ=19 is the canonical enabled rate); hang-watchdog
+    one-shot stacks appear under a 'hung' root frame regardless."""
+    import ray_tpu
+    from ray_tpu._private import profiler
+    from ray_tpu.util import state
+
+    address = _resolve_address(args.address)
+    ray_tpu.init(address=address, ignore_reinit_error=True)
+    lines = state.flamegraph_collapsed(
+        node_id=args.node, task_name=args.task_name,
+        critical_path_trace=args.critical_path)
+    if not lines:
+        print("no profile samples yet (set RAY_TPU_PROFILE_HZ=19 to enable "
+              "continuous sampling; hung-task stacks appear automatically)")
+        return 1
+    if args.svg:
+        svg = profiler.render_svg(lines)
+        with open(args.svg, "w") as f:
+            f.write(svg)
+        print(f"wrote {args.svg} ({sum(1 for _l in lines)} stacks)")
+    else:
+        for line in lines:
+            print(line)
     return 0
 
 
@@ -730,8 +806,43 @@ def main(argv=None) -> int:
                         "executing it")
     p.add_argument("--node", default=None,
                    help="node id (hex prefix ok); default: every node")
+    p.add_argument("--collapsed", action="store_true",
+                   help="emit one collapsed-stack line per thread "
+                        "(flamegraph.pl format, same universe as "
+                        "`ray_tpu flamegraph`) instead of readable dumps")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=_cmd_stack)
+
+    p = sub.add_parser("critical-path",
+                       help="longest dependent chain of a trace / training "
+                            "step / LLM request with per-bucket attribution")
+    p.add_argument("--trace", default=None,
+                   help="trace id: DAG reconstruction over its spans")
+    p.add_argument("--step", type=int, default=None,
+                   help="pipeline training step number")
+    p.add_argument("--experiment", default=None,
+                   help="with --step: restrict to one experiment")
+    p.add_argument("--request", default=None,
+                   help="LLM request id: TTFT decomposition")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable JSON instead of the tree view")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=_cmd_critical_path)
+
+    p = sub.add_parser("flamegraph",
+                       help="cluster flamegraph from the continuous "
+                            "profiler (collapsed stacks or --svg)")
+    p.add_argument("--node", default=None,
+                   help="node id (hex prefix ok); default: every node")
+    p.add_argument("--task-name", default=None,
+                   help="restrict to samples of one task name")
+    p.add_argument("--critical-path", default=None, metavar="TRACE_ID",
+                   help="tag samples of tasks on this trace's critical "
+                        "path with an on_critical_path root frame")
+    p.add_argument("--svg", default=None, metavar="FILE",
+                   help="write a self-contained SVG flamegraph here")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=_cmd_flamegraph)
 
     p = sub.add_parser("blackbox",
                        help="harvested flight-recorder rings of dead "

@@ -285,6 +285,11 @@ class DpGradSync:
         self.last_wire_bytes = 0
         self.last_op_seconds = 0.0
         self.last_blocked_s = 0.0
+        # wall-clock stamps of the last launch/clip-barrier completion, so
+        # the critical-path engine can place the dp exchange on a step's
+        # absolute timeline next to the stage's op intervals
+        self.last_launch_ts = 0.0
+        self.last_complete_ts = 0.0
         # cumulative (for bench/report aggregation)
         self.total_wire_bytes = 0
         self.total_op_seconds = 0.0
@@ -334,6 +339,7 @@ class DpGradSync:
                 quant=self.quant, quorum=self.quorum))
         self._pending = (handles, treedef, meta)
         self.last_buckets = len(handles)
+        self.last_launch_ts = time.time()
         return len(handles)
 
     def wait_all(self, timeout_s: Optional[float] = None):
@@ -356,6 +362,7 @@ class DpGradSync:
         self.last_wire_bytes = wire
         self.last_op_seconds = op_s
         self.last_blocked_s = blocked
+        self.last_complete_ts = time.time()
         self.total_wire_bytes += wire
         self.total_op_seconds += op_s
         self.total_blocked_s += blocked

@@ -180,6 +180,7 @@ class StageExecutor:
         self.clock = BubbleClock()
         self.step_idx = 0
         self._op_comm_s = 0.0
+        self.last_cpath: Optional[Dict[str, Any]] = None  # last step's stamp
 
         host_params = params if params is not None else module.init_params(seed)
         self.specs = module.specs(host_params)
@@ -281,6 +282,9 @@ class StageExecutor:
         self.clock.reset()
         self._op_comm_s = 0.0
         step = self.step_idx
+        step_t0 = time.monotonic()
+        step_wall0 = time.time()
+        op_log: List[Any] = []  # [kind, start_rel, dur, comm] per op
         acts: Dict[int, Any] = {}     # micro -> received/embedded input act
         grads_accum = None
         losses: List[float] = []
@@ -390,6 +394,8 @@ class StageExecutor:
             if comm > 0.0:
                 self.clock.charge("comm", comm)
             self.clock.charge(op.kind, dt - comm)
+            op_log.append([op.kind, round(t0 - step_t0, 6), round(dt, 6),
+                           round(comm, 6)])
 
         self.step_idx += 1
         out = self.clock.summary()
@@ -404,7 +410,55 @@ class StageExecutor:
                         self.dp_sync.last_wire_bytes
                         if self.dp_sync is not None else 0})
         self._emit_metrics(out)
+        self._emit_cpath(step, step_wall0, op_log, out)
         return out
+
+    def _emit_cpath(self, step: int, t0_wall: float, op_log: List[Any],
+                    out: Dict[str, Any]) -> None:
+        """Stamp this stage's per-op intervals as a CPATH annotation on the
+        task-event stream, so ``state.critical_path(step=N)`` reconstructs
+        the step's per-stage breakdown and reconciles it against the
+        BubbleClock.  The payload is also kept on ``self.last_cpath`` so
+        core-less harnesses (benches, unit tests) reconcile directly;
+        without a core worker the event emit is skipped — the step itself
+        never depends on observability."""
+        wall = sum(d for _k, _s, d, _c in op_log)
+        exp = self.experiment or self.job or ""
+        self.last_cpath = {
+            "kind": "train_step",
+            "experiment": exp,
+            "stage": self.stage,
+            "step": step,
+            "t0": t0_wall,
+            "wall_s": round(wall, 6),
+            "ops": op_log,
+            "clock": {k: round(v, 6)
+                      for k, v in out.items()
+                      if isinstance(v, float)
+                      and k in ("step_wall_s", "busy_s", "xfer_s",
+                                "bubble_s", "bubble_fraction", "comm_s")},
+        }
+        try:
+            from ray_tpu._private.config import RayConfig
+            from ray_tpu._private.worker import global_worker_core
+
+            core = global_worker_core()
+            if core is None or not RayConfig.task_events_enabled:
+                return
+            core.emit_raw_event({
+                "task_id": f"cpath-train-{exp}-{self.stage}-{step}",
+                "attempt": 0,
+                "name": f"train_step:{exp}:s{self.stage}:{step}",
+                "state": "CPATH",
+                "ts": time.time(),
+                "job_id": core.job_id.hex(),
+                "type": "ANNOTATION",
+                "node_id": core._node_id_hex,
+                "worker_id": core._worker_id_hex,
+                "cpath": self.last_cpath,
+            }, terminal=True)
+        except Exception:
+            pass  # observability must never fail a step
 
     def _commit(self, grads_accum, losses, below_gnormsq, step: int,
                 tmo: float) -> Dict[str, float]:

@@ -413,6 +413,98 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
     return trace
 
 
+def critical_path(trace_id: Optional[str] = None,
+                  step: Optional[int] = None,
+                  request_id: Optional[str] = None,
+                  experiment: Optional[str] = None) -> Dict[str, Any]:
+    """Critical path of one trace, training step, or served LLM request —
+    the longest dependent chain that bounded the end-to-end wall, with each
+    on-path second attributed to a named bucket (queue, dispatch, exec,
+    object-transfer, collective-comm, pipeline-bubble, admission-wait).
+
+    Exactly one selector:
+
+    - ``trace_id``: DAG reconstruction over the trace's spans (tasks +
+      user spans), per-node self time + per-edge slack.
+    - ``step`` (+ optional ``experiment``): per-stage breakdown of one
+      pipeline training step from the CPATH stamps each StageExecutor
+      emits, reconciled against its BubbleClock.
+    - ``request_id``: TTFT decomposition of one LLM request (admission
+      queue -> prefill chunks -> decode -> preemption re-waits).
+
+    Also publishes the result's bucket attribution as the
+    ``critical_path_seconds{bucket=...}`` gauge so the last analyzed
+    path is scrapeable.
+    """
+    from ray_tpu._private import critical_path as cp
+    from ray_tpu._private.metrics import Gauge
+
+    selectors = [s is not None for s in (trace_id, step, request_id)]
+    if sum(selectors) != 1:
+        raise ValueError(
+            "critical_path() needs exactly one of trace_id=, step=, "
+            "request_id=")
+    rows = list_tasks(limit=100_000)
+    if trace_id is not None:
+        result = cp.compute(rows, trace_id)
+    elif step is not None:
+        result = cp.train_step(rows, step, experiment=experiment)
+    else:
+        result = cp.llm_request(rows, request_id)
+    g = Gauge("critical_path_seconds",
+              "bucket attribution of the most recently analyzed critical "
+              "path (state.critical_path publishes on each call)")
+    for bucket, v in result["buckets"].items():
+        g.set(v, {"bucket": bucket})
+    return result
+
+
+def get_profile(node_id: Optional[str] = None,
+                task_name: Optional[str] = None) -> List[List[Any]]:
+    """Raw cluster profile aggregate from the GCS:
+    ``[[node, task, subsystem, tag, stack, count], ...]``.  The local
+    process's not-yet-pushed delta is merged in so a driver profiling
+    itself sees its own samples immediately."""
+    from ray_tpu._private import profiler
+
+    entries = _gcs_call("get_profile",
+                        {"node_id": node_id, "task_name": task_name})
+    if profiler.SAMPLING and node_id is None:
+        for task, subsystem, stack, count in profiler.peek():
+            if task_name is not None and task != task_name:
+                continue
+            entries.append(["driver", task, subsystem, "", stack, count])
+    return entries
+
+
+def flamegraph_collapsed(node_id: Optional[str] = None,
+                         task_name: Optional[str] = None,
+                         include_hung: bool = True,
+                         critical_path_trace: Optional[str] = None
+                         ) -> List[str]:
+    """The cluster profile in standard collapsed-stack format (one
+    ``frame;frame;frame count`` line per distinct stack — flamegraph.pl /
+    speedscope input).  Hang-watchdog one-shot stacks appear under a
+    ``hung`` root frame; with ``critical_path_trace`` set, samples of tasks
+    on that trace's critical path gain an ``on_critical_path`` root frame
+    (a read-time join — sampling itself never computes paths)."""
+    from ray_tpu._private import profiler
+
+    entries = [[task, subsystem, stack, count, tag]
+               for _node, task, subsystem, tag, stack, count
+               in get_profile(node_id=node_id, task_name=task_name)
+               if include_hung or tag != "hung"]
+    critical: Optional[set] = None
+    if critical_path_trace is not None:
+        critical = set(critical_path(trace_id=critical_path_trace)
+                       .get("on_path_task_ids", []))
+        names = {row.get("name") for row in list_tasks(limit=100_000)
+                 if row.get("task_id") in critical}
+        critical |= {n for n in names if n}
+    return profiler.collapsed_lines(entries, tag_hung=include_hung,
+                                    critical_tasks=critical)
+
+
 def get_trace(trace_id: str) -> List[Dict[str, Any]]:
     """Spans of one trace, parent-linked and time-ordered — the span context
     travels inside task specs, so every task/actor call submitted (however

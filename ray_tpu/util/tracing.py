@@ -172,6 +172,11 @@ def export_otlp(filename: str, trace_id: Optional[str] = None,
             "oldest (raise the limit= parameter to export them)",
             len(rows), limit, len(rows) - limit)
         rows = rows[-limit:]  # fold order is oldest-first
+    # Per-trace critical paths, so Jaeger/Tempo can filter/highlight the
+    # chain that actually bounded each trace (ray_tpu.on_critical_path).
+    from ray_tpu._private import critical_path as _cp
+
+    on_path = _cp.on_path_span_ids(rows)
     spans: List[Dict[str, Any]] = []
     for row in rows:
         if row.get("trace_id") is None:
@@ -191,6 +196,9 @@ def export_otlp(filename: str, trace_id: Optional[str] = None,
         for k in ("node_id", "worker_id", "pid", "attempt"):
             if row.get(k) is not None:
                 attrs.append(_otlp_attr(f"ray_tpu.{k}", row[k]))
+        span_key = row.get("span_id") or row["task_id"]
+        if span_key in on_path.get(row["trace_id"], ()):
+            attrs.append(_otlp_attr("ray_tpu.on_critical_path", True))
         for k, v in (row.get("attributes") or {}).items():
             attrs.append(_otlp_attr(k, v))
         span = {

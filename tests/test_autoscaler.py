@@ -37,6 +37,7 @@ def _gcs_call(method, msg):
     return core.io.run(core.gcs_conn.call(method, msg))
 
 
+@pytest.mark.slow
 def test_scale_up_on_demand_then_reap(scaled_cluster):
     cluster, provider, _ = scaled_cluster
     config = AutoscalingConfig(

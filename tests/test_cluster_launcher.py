@@ -150,6 +150,7 @@ def test_gcloud_tpu_api_replay(tmp_path):
 
 
 # ------------------------------------------------------------ up / down e2e
+@pytest.mark.slow
 def test_ray_up_fake_cluster_e2e(tmp_path, monkeypatch):
     """`ray up` on the fake TPU cloud: head + one v5e-8 slice (2 hosts) come
     up through the monitor-owned provider; `ray down` reaps the slice

@@ -163,6 +163,57 @@ def test_state_log_api(cluster):
         assert isinstance(text, str)
 
 
+def test_critical_path_and_flamegraph_endpoints(cluster):
+    """/api/critical_path renders a real trace's chain; /api/flamegraph and
+    /flamegraph.svg serve the profiler aggregate (well-formed even when
+    profiling is off and the aggregate is empty — ISSUE 18)."""
+    import time as _t
+
+    from ray_tpu.util.tracing import trace_span
+
+    dash, port = _start_dashboard()
+
+    @ray_tpu.remote
+    def dash_cpath_child(x):
+        return x * 3
+
+    with trace_span("dash-cpath") as span:
+        tid = span.trace_id
+        assert ray_tpu.get(dash_cpath_child.remote(2), timeout=30) == 6
+
+    def get(path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            return json.loads(r.read())
+
+    deadline = _t.time() + 30
+    out = None
+    while _t.time() < deadline:
+        try:
+            out = get(f"/api/critical_path?trace_id={tid}")
+        except urllib.error.HTTPError:
+            out = None  # 500 until the trace's spans all land
+        if out and {"dash-cpath", "dash_cpath_child"} <= {
+                n["name"].rsplit(".", 1)[-1] for n in out["nodes"]}:
+            break
+        _t.sleep(0.5)
+    assert out is not None, "critical_path endpoint never served the trace"
+    assert abs(sum(out["buckets"].values()) - out["path_s"]) < 5e-6
+    assert out["on_path_span_ids"]
+
+    flame = get("/api/flamegraph")
+    assert isinstance(flame["collapsed"], list)
+    from ray_tpu._private.profiler import parse_collapsed
+
+    parse_collapsed(flame["collapsed"])  # valid collapsed format (or empty)
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/flamegraph.svg", timeout=30) as r:
+        assert r.headers["Content-Type"].startswith("image/svg+xml")
+        body = r.read()
+    assert body.startswith(b"<svg")
+
+
 def test_hangs_and_stacks_endpoints(cluster):
     """/api/hangs is well-formed when nothing hangs; /api/stacks serves the
     GCS-proxied per-node thread dumps (ISSUE 3 live-introspection layer)."""

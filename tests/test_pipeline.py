@@ -320,6 +320,7 @@ def _pipeline_loop_cfg(steps, job):
     }
 
 
+@pytest.mark.slow
 def test_jax_trainer_pipeline_two_stage_and_cross_stage_restore(
         ray_start_regular, tmp_path):
     """JaxTrainer(pipeline_stages=2): two single-worker stage gangs, channel

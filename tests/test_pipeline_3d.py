@@ -443,6 +443,7 @@ def test_jax_trainer_mesh_validates_worker_count():
         JaxTrainer(gpt2_pipeline_loop, mesh=(0, 1))
 
 
+@pytest.mark.slow
 def test_jax_trainer_dp2_matches_single_replica(ray_start_regular, tmp_path):
     """JaxTrainer(mesh=(2, 1)): two replica workers over a REAL collective
     group, each on half the global batch — stage-0 losses equal the

@@ -1,0 +1,205 @@
+"""Continuous sampling profiler (ISSUE 18): collapsed-stack emission must
+round-trip through a standard flamegraph.pl-style parser, the live sampler
+must fold real thread stacks with zero hot-path cost when disabled, and
+hang-watchdog one-shot stacks must land in the same collapsed universe."""
+
+import threading
+import time
+import traceback
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from ray_tpu._private import profiler
+
+
+@pytest.fixture(autouse=True)
+def _clean_sampler():
+    # every test starts with a stopped sampler and an empty fold dict
+    profiler.stop()
+    profiler.take_delta()
+    yield
+    profiler.stop()
+    profiler.take_delta()
+
+
+# ======================================================= collapsed format
+
+def test_collapsed_lines_round_trip_through_flamegraph_parser():
+    entries = [
+        ["my_task", "train", "mod:run;mod:step", 7],
+        ["my_task", "train", "mod:run;mod:step", 3],   # merges
+        ["", "core", "core_worker:loop", 5],
+        ["other task", "llm", "engine:step_once;engine:_emit", 2],
+    ]
+    lines = profiler.collapsed_lines(entries)
+    parsed = profiler.parse_collapsed(lines)
+    # counts survive the round trip, duplicates merged
+    assert sum(parsed.values()) == 17
+    assert parsed[("train", "task:my_task", "mod:run", "mod:step")] == 10
+    assert parsed[("core", "core_worker:loop")] == 5
+    # task names are scrubbed so frames never contain the count separator
+    key = next(k for k in parsed if "task:other_task" in k)
+    assert parsed[key] == 2
+    for line in lines:
+        stack, _, count = line.rpartition(" ")
+        assert count.isdigit() and " " not in stack
+
+
+def test_parse_collapsed_rejects_garbage():
+    with pytest.raises(ValueError):
+        profiler.parse_collapsed(["no trailing count"])
+    with pytest.raises(ValueError):
+        profiler.parse_collapsed([" 12"])
+    assert profiler.parse_collapsed(["", "  "]) == {}
+
+
+def test_hung_and_critical_root_tags():
+    entries = [
+        ["stuck_task", "core", "worker:wait", 1, "hung"],
+        ["hot_task", "train", "sched:step", 4],
+    ]
+    lines = profiler.collapsed_lines(entries, tag_hung=True,
+                                     critical_tasks={"hot_task"})
+    by_root = {line.split(";")[0]: line for line in lines}
+    assert "hung" in by_root
+    assert by_root["hung"].startswith("hung;core;task:stuck_task;")
+    assert "on_critical_path" in by_root
+    assert by_root["on_critical_path"].split(" ")[0].endswith("sched:step")
+    # without tag_hung the one-shot stack folds in untagged
+    plain = profiler.collapsed_lines(entries)
+    assert not any(line.startswith("hung;") for line in plain)
+
+
+def test_fold_formatted_stack():
+    text = "".join(traceback.format_stack())
+    stack = profiler.fold_formatted_stack(text)
+    frames = stack.split(";")
+    assert len(frames) >= 2
+    # root-first: this test function is the leaf-most real frame
+    assert frames[-1].startswith("test_profiler:")
+    assert all(" " not in f and f for f in frames)
+    # folded dumps parse as one collapsed line
+    assert profiler.parse_collapsed([f"{stack} 1"]) == {
+        tuple(frames): 1}
+
+
+def test_render_svg_is_valid_xml_with_counts():
+    lines = profiler.collapsed_lines([
+        ["t", "train", "a:f;b:g", 30],
+        ["t", "train", "a:f;c:h", 10],
+        ["", "user", "d:main", 60],
+    ])
+    svg = profiler.render_svg(lines, title="unit <fixture>")
+    root = ET.fromstring(svg)  # well-formed XML
+    assert root.tag.endswith("svg")
+    assert "100 samples" in svg
+    assert "&lt;fixture&gt;" in svg  # titles are escaped
+    rects = [el for el in root.iter() if el.tag.endswith("rect")]
+    assert len(rects) >= 4  # background + frames
+
+
+# ========================================================== live sampler
+
+def test_sampler_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_PROFILE_HZ", raising=False)
+    assert profiler.resolve_hz() == 0.0
+    assert profiler.ensure_started() is False
+    assert profiler.SAMPLING is False
+    assert profiler.take_delta() == []
+
+
+def test_sampler_folds_real_stacks_and_delta_drains(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_PROFILE_HZ", "200")
+    tags = {}
+
+    stop = threading.Event()
+
+    def busy_bee():
+        tags[threading.get_ident()] = "bee_task"
+        while not stop.is_set():
+            sum(range(200))
+
+    t = threading.Thread(target=busy_bee, daemon=True)
+    t.start()
+    try:
+        assert profiler.ensure_started(lambda ident: tags.get(ident)) is True
+        assert profiler.SAMPLING is True
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if any(task == "bee_task" for task, _s, _st, _c
+                   in profiler.peek()):
+                break
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        profiler.stop()
+    # peek was non-destructive: the delta still carries the samples
+    delta = profiler.take_delta()
+    bee = [e for e in delta if e[0] == "bee_task"]
+    assert bee, delta
+    task, subsystem, stack, count = bee[0]
+    assert count >= 1
+    assert "busy_bee" in stack
+    # the fixture's module never enters ray_tpu => user subsystem
+    assert subsystem == "user"
+    # drained: a second delta has nothing new for the dead thread
+    assert not [e for e in profiler.take_delta() if e[0] == "bee_task"]
+    # and the emitted entries render as parseable collapsed lines
+    parsed = profiler.parse_collapsed(profiler.collapsed_lines(bee))
+    assert sum(parsed.values()) == sum(e[3] for e in bee)
+    assert profiler.SAMPLING is False  # stop() flips the hot-path guard
+
+
+def test_resolve_hz_env_beats_config(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_PROFILE_HZ", "19")
+    assert profiler.resolve_hz() == 19.0
+    monkeypatch.setenv("RAY_TPU_PROFILE_HZ", "not-a-number")
+    assert profiler.resolve_hz() == 0.0
+    monkeypatch.delenv("RAY_TPU_PROFILE_HZ")
+    from ray_tpu._private.config import RayConfig
+
+    assert profiler.resolve_hz() == float(RayConfig.profile_hz)
+
+
+# ===================================================== GCS aggregation
+
+def test_gcs_profile_aggregation_and_eviction():
+    import asyncio
+
+    from ray_tpu._private.gcs.server import GcsServer
+
+    gcs = GcsServer.__new__(GcsServer)
+    gcs.profile = {}
+
+    async def drive():
+        await gcs.rpc_profile_push(None, {"node_id": "n1", "entries": [
+            ["t1", "train", "a:f;b:g", 5],
+            ["t1", "train", "a:f;b:g", 2],          # merges to 7
+            ["", "core", "w:loop", 1, "hung"],      # tagged one-shot
+        ]})
+        await gcs.rpc_profile_push(None, {"node_id": "n2", "entries": [
+            ["t1", "train", "a:f;b:g", 3],          # distinct node
+        ]})
+        rows = await gcs.rpc_get_profile(None, {})
+        by = {(r[0], r[4]): r for r in rows}
+        assert by[("n1", "a:f;b:g")][5] == 7
+        assert by[("n2", "a:f;b:g")][5] == 3
+        hung = next(r for r in rows if r[3] == "hung")
+        assert hung[5] == 1
+        # node-prefix and task filters
+        assert all(r[0] == "n2" for r in await gcs.rpc_get_profile(
+            None, {"node_id": "n2"}))
+        assert all(r[1] == "t1" for r in await gcs.rpc_get_profile(
+            None, {"task_name": "t1"}))
+        # eviction: shove past the cap; lowest-count entries go first
+        from ray_tpu._private.config import RayConfig
+        cap = RayConfig.profile_max_stacks
+        await gcs.rpc_profile_push(None, {"node_id": "n3", "entries": [
+            ["bulk", "user", f"s:{i}", i + 10] for i in range(cap + 50)]})
+        assert len(gcs.profile) <= cap
+        remaining = await gcs.rpc_get_profile(None, {"node_id": "n3"})
+        assert min(r[5] for r in remaining) > 10  # smallest counts evicted
+
+    asyncio.run(drive())

@@ -55,6 +55,7 @@ def _gcs_call(method, msg):
     return core.io.run(core.gcs_conn.call(method, msg))
 
 
+@pytest.mark.slow
 def test_strict_spread_gang_scales_v5e16_slice(tpu_cluster):
     """A STRICT_SPREAD gang of 4 TPU-host bundles makes the autoscaler
     provision one simulated v5e-16 slice (4 hosts) through the fake cloud —
