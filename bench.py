@@ -663,6 +663,45 @@ def main() -> None:
         except Exception as e:
             result["serve_load_error"] = repr(e)
 
+    # RL sampling-loop rows (ISSUE 19): interleaved best-of-3 A/B of the
+    # relaunch IMPALA loop vs the podracer streaming loop (env-steps/s),
+    # plus a Sebulba row recording inference-batch occupancy and fragment
+    # staleness p50/p95.  Subprocess so actor runtimes can't leak.
+    if os.environ.get("RAY_TPU_BENCH_RL", "1") != "0":
+        import subprocess
+        import sys
+
+        code = ("import json, ray_tpu; from ray_tpu._private.ray_perf "
+                "import host_cpu_count; "
+                "from ray_tpu._private.rl_bench import run_rl_bench; "
+                "ray_tpu.init(num_cpus=max(host_cpu_count(), 4), "
+                "object_store_memory=512 * 1024**2); "
+                "print('RL_STEPS=' + json.dumps(run_rl_bench()))")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.update(rig_env)
+        try:
+            proc = subprocess.Popen([sys.executable, "-c", code],
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.PIPE, text=True,
+                                    env=env, start_new_session=True)
+            try:
+                stdout, stderr = proc.communicate(timeout=540)
+            except subprocess.TimeoutExpired:
+                import signal
+
+                os.killpg(proc.pid, signal.SIGKILL)
+                proc.wait()
+                raise
+            for line in stdout.splitlines():
+                if line.startswith("RL_STEPS="):
+                    result["rl_steps"] = json.loads(line[len("RL_STEPS="):])
+                    break
+            else:
+                result["rl_steps_error"] = (stderr or "no output")[-500:]
+        except Exception as e:
+            result["rl_steps_error"] = repr(e)
+
     # Lint gate wall-clock (ISSUE 5): `ray_tpu lint` runs as a tier-1 test
     # on every PR; record its full-tree cost so the gate visibly stays
     # inside its < 10 s CPU budget instead of quietly becoming the slow
@@ -678,7 +717,8 @@ def main() -> None:
     # without seeing the difference in the row itself.
     for key in ("micro", "collective", "recovery", "pipeline", "train_3d",
                 "llm_decode_throughput", "watchdog_overhead",
-                "flight_recorder", "profiler", "lint_tree", "serve_load"):
+                "flight_recorder", "profiler", "lint_tree", "serve_load",
+                "rl_steps"):
         if isinstance(result.get(key), dict):
             bench_rig.stamp(result[key], rig)
 

@@ -241,6 +241,11 @@ class CoreWorker:
         self._recovery_attempts: Dict[ObjectID, int] = {}
         self._recovery_inflight: set = set()
 
+        # oid -> mark callbacks of wait() calls sharing one inflight
+        # plasma_wait seal long-poll (see _arm_plasma_wait)
+        self._plasma_waits: Dict[ObjectID, List] = {}
+        self._plasma_waits_lock = threading.Lock()
+
         self._owner_conns: Dict[Tuple[str, int], rpc.Connection] = {}
         self._worker_conns: Dict[Tuple[str, int], rpc.Connection] = {}
         self._nodelet_conns: Dict[Tuple[str, int], rpc.Connection] = {self_addr_key(nodelet_addr): self.nodelet_conn}
@@ -912,10 +917,15 @@ class CoreWorker:
                 continue
             owner_addr = r.owner_addr()
             if owner_addr is None or owner_addr == self.addr:
-                slow_poll.append(r)  # plasma-resident: no event source
+                # plasma-resident (e.g. a streaming item ref): sealed-ness
+                # is checked by the contains sweep below; if it comes up
+                # empty and we are about to sleep, a seal-event long-poll
+                # (_arm_plasma_wait) becomes the event source
+                slow_poll.append(r)
                 continue
             self.io.spawn(self._wait_borrowed(r, deadline, mark))
 
+        slow_armed = not slow_poll
         while True:
             with ready_lock:
                 snapshot = set(ready_oids)
@@ -930,11 +940,65 @@ class CoreWorker:
             if rem is not None and rem <= 0:
                 break
             done_event.clear()
-            step = RayConfig.wait_poll_interval_ms / 1000.0 if slow_poll \
-                else 5.0
+            if not slow_armed:
+                # arm seal long-polls only for refs the first contains sweep
+                # missed, and only when this wait() actually sleeps — a
+                # timeout=0 scoop or an already-sealed item needs no event
+                # source (one RPC + one io task per arm is not free)
+                slow_armed = True
+                with ready_lock:
+                    snapshot = set(ready_oids)
+                for r in slow_poll:
+                    if r.oid.binary() not in snapshot:
+                        self._arm_plasma_wait(r.oid, mark)
+            # with the long-poll armed the contains sweep is a backstop,
+            # not the event source: tick it at 250ms, not
+            # wait_poll_interval_ms — per-tick contains RPCs otherwise eat
+            # the very CPU the producers need
+            step = max(RayConfig.wait_poll_interval_ms, 250) / 1000.0 \
+                if slow_poll else 5.0
             done_event.wait(step if rem is None else min(step, rem))
         ready_set = {id(r) for r in ready}
         return ready, [r for r in pending if id(r) not in ready_set]
+
+    def _arm_plasma_wait(self, oid: ObjectID, mark) -> None:
+        """Attach ``mark`` to a seal-event long-poll for a locally-owned
+        plasma-resident oid.  One in-flight ``plasma_wait`` per oid no
+        matter how many wait() calls watch it (a fragment-stream consumer
+        re-waits the same speculative item ref every pass); callbacks
+        accumulate on the inflight entry and all fire on seal."""
+        with self._plasma_waits_lock:
+            cbs = self._plasma_waits.get(oid)
+            if cbs is not None:
+                cbs.append(mark)
+                return
+            self._plasma_waits[oid] = [mark]
+        self.io.spawn(self._plasma_wait_loop(oid))
+
+    async def _plasma_wait_loop(self, oid: ObjectID):
+        """Long-poll the local store until ``oid`` seals.  Holds the bare
+        ObjectID only — an ObjectRef here would pin the ref count and keep
+        a dead stream's items alive forever.  Exits (leaving the slow poll
+        as the only watcher) when the oid stops being locally tracked, on
+        any RPC failure, or once sealed."""
+        ready = False
+        try:
+            while self.ref_counter.has(oid):
+                try:
+                    ready = await self.nodelet_conn.call(
+                        "plasma_wait",
+                        {"oid": oid.binary(), "timeout": 10.0},
+                        timeout=10.0 + RayConfig.gcs_rpc_timeout_s)
+                except Exception:
+                    return
+                if ready:
+                    return
+        finally:
+            with self._plasma_waits_lock:
+                cbs = self._plasma_waits.pop(oid, [])
+            if ready:
+                for cb in cbs:
+                    cb(oid.binary())
 
     async def _wait_borrowed(self, ref: ObjectRef, deadline, mark):
         """One long-poll to the owner per borrowed ref (owner blocks until
@@ -1373,7 +1437,8 @@ class CoreWorker:
     def submit_task(self, fn, args, kwargs, *, name: str, num_returns: int,
                     resources: Dict[str, float], strategy: SchedulingStrategy,
                     max_retries: int, retry_exceptions: bool = False,
-                    runtime_env: Optional[dict] = None) -> List[ObjectRef]:
+                    runtime_env: Optional[dict] = None,
+                    stream_returns: bool = False) -> List[ObjectRef]:
         t_submit = time.time()
         blob, key = self._function_payload(fn)
         spec_args, kw_keys, holds = self._build_args(args, kwargs)
@@ -1388,7 +1453,7 @@ class CoreWorker:
             scheduling_strategy=strategy, max_retries=max_retries,
             retry_exceptions=retry_exceptions,
             owner_worker_id=self.worker_id.binary(), owner_addr=self.addr,
-            runtime_env=runtime_env,
+            runtime_env=runtime_env, stream_returns=stream_returns,
             trace_id=trace_id, span_id=span_id, parent_span_id=parent_span,
         )
         refs = []
@@ -1439,7 +1504,8 @@ class CoreWorker:
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str, args, kwargs,
                           *, num_returns: int = 1,
-                          max_task_retries: int = 0) -> List[ObjectRef]:
+                          max_task_retries: int = 0,
+                          stream_returns: bool = False) -> List[ObjectRef]:
         t_submit = time.time()
         spec_args, kw_keys, holds = self._build_args(args, kwargs)
         t_ser = time.time()
@@ -1452,7 +1518,7 @@ class CoreWorker:
             kwargs_keys=kw_keys, num_returns=num_returns, resources={},
             owner_worker_id=self.worker_id.binary(), owner_addr=self.addr,
             actor_id=actor_id, actor_method_name=method_name,
-            max_task_retries=max_task_retries,
+            max_task_retries=max_task_retries, stream_returns=stream_returns,
             trace_id=trace_id, span_id=span_id, parent_span_id=parent_span,
         )
         refs = []
@@ -2386,10 +2452,12 @@ class CoreWorker:
             returns.append(self._pack_one_return(oid, ser, contained))
         return {"status": "ok", "returns": returns}
 
-    def _pack_one_return(self, oid: ObjectID, ser, contained) -> tuple:
+    def _pack_one_return(self, oid: ObjectID, ser, contained,
+                         force_plasma: bool = False) -> tuple:
         """One return entry in the completion wire format (shared by fixed
         and dynamic packing)."""
-        if ser.total_bytes() > RayConfig.max_direct_call_object_size:
+        if force_plasma or \
+                ser.total_bytes() > RayConfig.max_direct_call_object_size:
             self.plasma.put_serialized(oid, ser)
             return (oid.binary(), "plasma", ser.total_bytes(), contained)
         bufs, copied = freeze_buffers(ser.buffers)
@@ -2402,10 +2470,17 @@ class CoreWorker:
         becomes its own caller-owned object (indices 1..N), and the primary
         return (index 0) is the list of their (oid, owner) descriptors the
         ObjectRefGenerator materializes driver-side (reference:
-        num_returns='dynamic' — refs available when the task completes)."""
+        num_returns='dynamic' — refs available when the task completes).
+
+        ``spec.stream_returns`` (num_returns='streaming') forces every item
+        into plasma at yield time regardless of size: the item is visible to
+        the caller's speculative refs the moment it is sealed, which is what
+        lets ObjectRefGenerator.stream() consume a long-running generator
+        WHILE it is still producing."""
         returns = []
         metas = []
         put_in_plasma = []
+        stream = bool(getattr(spec, "stream_returns", False))
         try:
             for i, value in enumerate(out):
                 oid = ObjectID.from_task(spec.task_id, i + 1)
@@ -2414,7 +2489,8 @@ class CoreWorker:
                     raise ValueError(
                         "ObjectRefs nested inside dynamically yielded "
                         "values are not supported yet")
-                entry = self._pack_one_return(oid, ser, ())
+                entry = self._pack_one_return(oid, ser, (),
+                                              force_plasma=stream)
                 if entry[1] == "plasma":
                     put_in_plasma.append(oid)
                 returns.append(entry)

@@ -92,6 +92,12 @@ POINTS: Dict[str, dict] = {
         "detail": "node id hex",
         "actions": ("kill",),
     },
+    "rllib.sample": {
+        "where": "rllib.env.env_runner.EnvRunner.sample, before the "
+                 "fragment's first env step (streaming and relaunch paths)",
+        "detail": "'runner<N>' of this env-runner in its gang",
+        "actions": ("kill",),
+    },
     "plasma.seal": {
         "where": "object_store.PlasmaClient._queue_seal (arena fused "
                  "put/seal): 'torn' drops the seal notify after the bytes "

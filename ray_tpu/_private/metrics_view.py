@@ -343,6 +343,53 @@ def summarize_llm(samples: List[Sample]) -> Dict[str, Dict[str, float]]:
     return out
 
 
+# ------------------------------------------------------------ rllib view
+
+def summarize_rllib(samples: List[Sample]) -> Dict[str, Dict[str, float]]:
+    """Per-job Podracer RL view: env-step/fragment throughput counters,
+    fragment staleness (policy versions behind at consumption), learner
+    update + gradient-allreduce latency, Sebulba inference-pool batch
+    occupancy, published weight version and env-runner respawns
+    (ray_tpu_rllib_* series)."""
+    keys = ("job",)
+    steps = _sum_by(samples, "ray_tpu_rllib_env_steps_total", keys)
+    frags = _sum_by(samples, "ray_tpu_rllib_fragments_total", keys)
+    infer_req = _sum_by(samples, "ray_tpu_rllib_inference_requests_total",
+                        keys)
+    restarts = _sum_by(samples, "ray_tpu_rllib_runner_restarts_total", keys)
+    version = _max_by(samples, "ray_tpu_rllib_weight_version", keys)
+    stale = _hist_by(samples, "ray_tpu_rllib_fragment_staleness", keys)
+    upd = _hist_by(samples, "ray_tpu_rllib_learner_update_seconds", keys)
+    ar = _hist_by(samples, "ray_tpu_rllib_learner_allreduce_seconds", keys)
+    batch = _hist_by(samples, "ray_tpu_rllib_inference_batch_size", keys)
+    out: Dict[str, Dict[str, float]] = {}
+    for joined, k in _joined(set(steps) | set(frags) | set(infer_req)
+                             | set(restarts) | set(version) | set(stale)
+                             | set(upd) | set(ar) | set(batch)):
+        s = stale.get(k, {})
+        u = upd.get(k, {})
+        a = ar.get(k, {})
+        b = batch.get(k, {})
+        out[joined] = {
+            "env_steps": steps.get(k, 0.0),
+            "fragments": frags.get(k, 0.0),
+            "weight_version": version.get(k, 0.0),
+            "staleness_mean": s.get("mean", 0.0),
+            "staleness_p50": s.get("p50", 0.0),
+            "staleness_p95": s.get("p95", 0.0),
+            "updates": u.get("count", 0.0),
+            "update_mean_s": u.get("mean", 0.0),
+            "update_p95_s": u.get("p95", 0.0),
+            "allreduce_mean_s": a.get("mean", 0.0),
+            "allreduce_p95_s": a.get("p95", 0.0),
+            "inference_requests": infer_req.get(k, 0.0),
+            "inference_batch_mean": b.get("mean", 0.0),
+            "inference_batch_p95": b.get("p95", 0.0),
+            "runner_restarts": restarts.get(k, 0.0),
+        }
+    return out
+
+
 # --------------------------------------------------- dashboard history
 
 def history_point(samples: List[Sample]) -> Dict[str, Dict]:
@@ -367,4 +414,10 @@ def history_point(samples: List[Sample]) -> Dict[str, Dict]:
             "running": v["running"]}
         for k, v in summarize_llm(samples).items()
     }
-    return {"serve": serve, "data": data, "train": train, "llm": llm}
+    rllib = {
+        k: {"env_steps": v["env_steps"], "fragments": v["fragments"],
+            "version": v["weight_version"]}
+        for k, v in summarize_rllib(samples).items()
+    }
+    return {"serve": serve, "data": data, "train": train, "llm": llm,
+            "rllib": rllib}

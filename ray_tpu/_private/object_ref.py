@@ -107,11 +107,26 @@ class ObjectRefGenerator:
     value the remote generator produced.  Refs materialize when the task
     COMPLETES (dynamic semantics); iteration therefore blocks on task
     completion, then yields instantly.  If the generator is never
-    iterated, the yielded objects live until job end (no eager release)."""
+    iterated, the yielded objects live until job end (no eager release).
 
-    def __init__(self, primary_ref: "ObjectRef"):
+    ``num_returns="streaming"`` upgrades the handle: item oids are
+    deterministic (``ObjectID.from_task(task, i+1)``) and the executor
+    forces every yield into plasma at yield time, so :meth:`stream` can
+    hand out the i-th ref the moment the producer seals it — while the
+    task is still running.  On a plain dynamic handle :meth:`stream`
+    degrades gracefully to completion-time iteration (small items may
+    ride the completion reply and only become visible then)."""
+
+    def __init__(self, primary_ref: "ObjectRef", streaming: bool = False):
         self._primary = primary_ref
         self._refs = None
+        self._streaming = streaming
+        # i -> speculative ObjectRef handed out by item_ref().  The cache
+        # pins each speculative ref for the life of this handle: once the
+        # producer completes, its item oids become OWNED in the submitter's
+        # ref counter, and GC of a transient speculative ref would drive the
+        # count to zero and free a not-yet-consumed item from plasma.
+        self._spec_refs = {}
 
     def _materialize(self, timeout=None):
         if self._refs is None:
@@ -121,6 +136,10 @@ class ObjectRefGenerator:
             metas = worker_mod.get(self._primary, timeout=timeout)
             self._refs = [ObjectRef(ObjectID(ob), addr, wid)
                           for ob, addr, wid in metas]
+            # the durable refs above now hold the real items; cached
+            # speculative refs (including indexes past the final count)
+            # can release their tracking entries
+            self._spec_refs.clear()
         return self._refs
 
     def __iter__(self):
@@ -135,6 +154,78 @@ class ObjectRefGenerator:
     def completed(self, timeout=None) -> list:
         """Block until the task finishes; returns the ref list."""
         return list(self._materialize(timeout))
+
+    @property
+    def streaming(self) -> bool:
+        return self._streaming
+
+    def task_done(self) -> bool:
+        """True once the producing task finished (its primary return — the
+        ref-list meta — is ready).  Non-blocking."""
+        if self._refs is not None:
+            return True
+        from ray_tpu._private import worker as worker_mod
+
+        ready, _ = worker_mod.wait([self._primary], num_returns=1, timeout=0)
+        return bool(ready)
+
+    def item_ref(self, i: int) -> "ObjectRef":
+        """Speculative ref for the i-th yielded item, derivable BEFORE task
+        completion: dynamic item oids are ``from_task(task_id, i+1)`` and
+        the items are owned by this caller (the submitter), so the ref can
+        be constructed locally.  The ref only becomes waitable once the
+        producer creates the item (immediately at yield time for streaming
+        handles); an index past the final item count never fires."""
+        if self._refs is not None and i < len(self._refs):
+            return self._refs[i]
+        ref = self._spec_refs.get(i)
+        if ref is None:
+            oid = ObjectID.from_task(self._primary.oid.task_id(), i + 1)
+            ref = ObjectRef(oid, self._primary.owner_addr(),
+                            self._primary.owner_worker_id())
+            self._spec_refs[i] = ref
+        return ref
+
+    def stream(self, timeout_s: Optional[float] = None, start: int = 0):
+        """Yield item refs as the producer creates them.
+
+        Each step waits on (speculative item i, primary): whichever lands
+        first decides — the item is yielded live, or the completed task's
+        materialized ref list finishes the tail (this is also where a
+        failed producer's error — e.g. ActorDiedError after a SIGKILL —
+        re-raises, so a consumer multiplexing several streams learns of a
+        dead producer at its next touch of that stream).  ``timeout_s``
+        bounds each individual step, not the whole stream."""
+        import time as _time
+
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu.exceptions import GetTimeoutError
+
+        i = start
+        while True:
+            if self._refs is not None:
+                while i < len(self._refs):
+                    yield self._refs[i]
+                    i += 1
+                return
+            spec = self.item_ref(i)
+            deadline = None if timeout_s is None \
+                else _time.monotonic() + timeout_s
+            while True:
+                rem = None if deadline is None \
+                    else deadline - _time.monotonic()
+                if rem is not None and rem <= 0:
+                    raise GetTimeoutError(
+                        f"stream item {i} not produced within {timeout_s}s")
+                ready, _ = worker_mod.wait([spec, self._primary],
+                                           num_returns=1, timeout=rem)
+                if any(r is spec for r in ready):
+                    yield spec
+                    i += 1
+                    break
+                if ready:  # primary completed (or failed): finish the tail
+                    self._materialize()  # raises the task's error if failed
+                    break
 
     def __repr__(self):
         n = len(self._refs) if self._refs is not None else "?"

@@ -1488,6 +1488,32 @@ def register_store_handlers(handlers: dict, store: PlasmaStore, waiters: dict,
     async def plasma_contains(conn, msg):
         return store.contains(ObjectID(msg["oid"]))
 
+    async def plasma_wait(conn, msg):
+        """Block until the object is sealed locally (or timeout) WITHOUT
+        pinning or mapping it — the event source behind ray.wait's
+        plasma-resident arm.  A bare contains-poll costs a full
+        wait_poll_interval_ms of latency per streamed item; parking on the
+        store's seal waiters delivers the wakeup the moment the producer
+        seals."""
+        oid = ObjectID(msg["oid"])
+        if store.contains(oid):
+            return True
+        fut = asyncio.get_event_loop().create_future()
+        waiters.setdefault(oid, []).append(fut)
+        try:
+            await asyncio.wait_for(fut, msg.get("timeout"))
+        except asyncio.TimeoutError:
+            lst = waiters.get(oid)
+            if lst is not None:
+                try:
+                    lst.remove(fut)
+                except ValueError:
+                    pass
+                if not lst:
+                    del waiters[oid]
+            return False
+        return store.contains(oid)
+
     async def plasma_release(conn, msg):
         # singular {"oid"} (legacy) or coalesced {"oids": [...]} releases
         oid_bins = msg.get("oids")
@@ -1523,6 +1549,7 @@ def register_store_handlers(handlers: dict, store: PlasmaStore, waiters: dict,
         plasma_seal_extent=plasma_seal_extent,
         plasma_get=plasma_get,
         plasma_contains=plasma_contains,
+        plasma_wait=plasma_wait,
         plasma_release=plasma_release,
         plasma_delete=plasma_delete,
         plasma_stats=plasma_stats,

@@ -100,6 +100,11 @@ class TaskSpec:
     actor_name: Optional[str] = None  # named actors
     namespace: Optional[str] = None
     runtime_env: Optional[dict] = None
+    # num_returns='streaming': dynamic packing (num_returns == -1) with every
+    # yielded item forced into plasma AT YIELD TIME, so the caller's
+    # speculative item refs (ObjectRefGenerator.stream) become waitable the
+    # moment the producer seals them — not at task completion.
+    stream_returns: bool = False
     # Attempt number (0 = first attempt); bumped on retry.
     attempt_number: int = 0
     # Tracing: span context propagated WITH the spec, the reference's

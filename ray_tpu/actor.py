@@ -22,10 +22,10 @@ from ray_tpu._private.ray_option_utils import (
 
 def _normalize_num_returns(num_returns):
     if num_returns == "streaming":
-        raise ValueError(
-            "num_returns='streaming' (refs delivered as produced) is not "
-            "implemented; use num_returns='dynamic' — refs materialize "
-            "when the method completes")
+        # streaming generator method: dynamic packing with items forced to
+        # plasma at yield time (-2 is the internal marker; the submit path
+        # sends num_returns=-1 + stream_returns=True)
+        return -2
     if num_returns == "dynamic":
         return -1
     return num_returns
@@ -53,19 +53,22 @@ class ActorMethod:
 
     def remote(self, *args, **kwargs):
         core = worker_mod.require_core()
+        stream = self._num_returns == -2
         refs = core.submit_actor_task(
             self._handle._actor_id, self._name, args, kwargs,
-            num_returns=self._num_returns,
+            num_returns=-1 if stream else self._num_returns,
             max_task_retries=self._handle._max_task_retries,
+            stream_returns=stream,
         )
-        if self._num_returns == -1:
+        if self._num_returns in (-1, -2):
             # dynamic generator method (reference: num_returns="dynamic" on
             # actor methods): the executor drains the generator via the same
             # _pack_dynamic_returns path tasks use; refs materialize when
-            # the method completes
+            # the method completes.  'streaming' (-2) additionally forces
+            # every yield into plasma so .stream() consumes refs live.
             from ray_tpu._private.object_ref import ObjectRefGenerator
 
-            return ObjectRefGenerator(refs[0])
+            return ObjectRefGenerator(refs[0], streaming=stream)
         if self._num_returns == 1:
             return refs[0]
         return refs

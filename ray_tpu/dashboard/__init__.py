@@ -28,6 +28,10 @@ aiohttp app serving
                               (ray_tpu_data_* series)
     GET /api/train          — per-experiment Train view
                               (ray_tpu_train_* series)
+    GET /api/rllib          — per-job Podracer RL view: env-step/fragment
+                              throughput, staleness percentiles, learner
+                              update + allreduce latency, inference-batch
+                              occupancy, runner respawns
     GET /api/llm            — per-engine LLM inference view: TTFT/ITL
                               percentiles, tokens/s, decode-batch occupancy,
                               KV-page utilization, preemptions, queue depth
@@ -558,6 +562,11 @@ class Dashboard:
 
             return mv.summarize_llm(_lib_samples())
 
+        def rllib_view():
+            from ray_tpu._private import metrics_view as mv
+
+            return mv.summarize_rllib(_lib_samples())
+
         def actors():
             out = []
             for a in self._call("get_all_actor_info"):
@@ -779,6 +788,7 @@ class Dashboard:
         app.router.add_get("/api/data", offload(data_view))
         app.router.add_get("/api/train", offload(train_view))
         app.router.add_get("/api/llm", offload(llm_view))
+        app.router.add_get("/api/rllib", offload(rllib_view))
         app.router.add_get("/api/critical_path", offload(critical_path))
         app.router.add_get("/api/flamegraph", offload(flamegraph))
         app.router.add_get("/flamegraph.svg", flamegraph_svg)

@@ -58,11 +58,12 @@ class RemoteFunction:
         opts = self._default_options
         core = worker_mod.require_core()
         num_returns = opts["num_returns"]
+        stream = False
         if num_returns == "streaming":
-            raise ValueError(
-                "num_returns='streaming' (refs delivered as produced) is "
-                "not implemented; use num_returns='dynamic' — refs "
-                "materialize when the task completes")
+            # streaming generators: dynamic packing, but every yielded item
+            # is forced into plasma at yield time so the caller can consume
+            # refs WHILE the task still runs (ObjectRefGenerator.stream)
+            num_returns, stream = -1, True
         if num_returns == "dynamic":
             # dynamic generators (reference: num_returns="dynamic" —
             # ObjectRefGenerator whose refs materialize when the task ends)
@@ -78,11 +79,12 @@ class RemoteFunction:
             max_retries=opts["max_retries"],
             retry_exceptions=opts["retry_exceptions"],
             runtime_env=opts["runtime_env"],
+            stream_returns=stream,
         )
         if num_returns == -1:
             from ray_tpu._private.object_ref import ObjectRefGenerator
 
-            return ObjectRefGenerator(refs[0])
+            return ObjectRefGenerator(refs[0], streaming=stream)
         if num_returns == 1:
             return refs[0]
         return refs

@@ -11,8 +11,12 @@ from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.offline import BC, BCConfig, MARWIL, MARWILConfig
+from ray_tpu.rllib.podracer import (FragmentStream, InferencePool,
+                                    LearnerGang, PodracerLearner,
+                                    WeightMailbox)
 
 __all__ = ["Algorithm", "AlgorithmConfig", "BC", "BCConfig",
-           "DQN", "DQNConfig", "IMPALA", "IMPALAConfig",
-           "MARWIL", "MARWILConfig", "PPO", "PPOConfig",
-           "SAC", "SACConfig"]
+           "DQN", "DQNConfig", "FragmentStream", "IMPALA", "IMPALAConfig",
+           "InferencePool", "LearnerGang", "MARWIL", "MARWILConfig",
+           "PPO", "PPOConfig", "PodracerLearner", "SAC", "SACConfig",
+           "WeightMailbox"]

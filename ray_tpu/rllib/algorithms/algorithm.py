@@ -107,18 +107,23 @@ def build_module_spec(config: "AlgorithmConfig") -> Dict[str, Any]:
 
 
 def build_runner_actors(config: "AlgorithmConfig", runner_cls,
-                        runner_kwargs: Dict[str, Any]) -> list:
+                        runner_kwargs: Dict[str, Any],
+                        index_key: Optional[str] = None) -> list:
     """Spawn a runner actor gang of any runner class (reference:
-    EnvRunnerGroup) — one CPU each, per-runner decorrelated seeds."""
+    EnvRunnerGroup) — one CPU each, per-runner decorrelated seeds.  With
+    ``index_key`` each runner also receives its gang index under that
+    kwarg (streaming consumers and chaos points address runners by it)."""
     import ray_tpu
 
     remote_cls = ray_tpu.remote(runner_cls)
-    return [
-        remote_cls.options(num_cpus=1).remote(
-            **{**runner_kwargs,
-               "seed": runner_kwargs.get("seed", 0) + 1000 * (i + 1)})
-        for i in range(config.num_env_runners)
-    ]
+    out = []
+    for i in range(config.num_env_runners):
+        kw = {**runner_kwargs,
+              "seed": runner_kwargs.get("seed", 0) + 1000 * (i + 1)}
+        if index_key is not None:
+            kw[index_key] = i
+        out.append(remote_cls.options(num_cpus=1).remote(**kw))
+    return out
 
 
 class Algorithm:

@@ -1,0 +1,315 @@
+"""Podracer learner gang: per-learner jitted V-trace, collective grad fold.
+
+Each learner holds a full replica of the policy (same seed => identical
+init on every rank) and runs the IMPALA V-trace update in two jitted
+halves: ``grads`` (loss + gradient) and ``apply`` (optimizer step).
+Between them the gradient pytree is raveled into one flat vector and
+folded through the gang's persistent collective group with
+``allreduce_async(op="mean")`` — optionally with ``quorum=K-1`` so one
+straggling learner never stalls a round (its late gradient parks at the
+root and folds into the next fold; arXiv:2505.23523).  Because every rank
+applies the SAME folded gradient to the SAME replica, parameters stay
+bitwise identical across the gang and rank 0 alone publishes versioned
+weights to the :class:`~ray_tpu.rllib.podracer.weights.WeightMailbox`.
+
+``world_size=1`` skips the group entirely, so a driver-local learner and a
+one-actor gang execute the identical jit programs — that is the bitwise
+Anakin/Sebulba parity contract the tests pin down.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def _vtrace_grads(module, params, batch, *, gamma, rho_clip, c_clip,
+                  vf_loss_coeff, entropy_coeff):
+    """Loss + gradient half of the IMPALA update (same math as the fused
+    single-learner update this package replaced; ops/vtrace.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.ops.vtrace import vtrace_from_fragments
+
+    T, K = batch["rewards"].shape
+    obs = batch["obs"].reshape(T * K, -1)
+    actions = batch["actions"].reshape(T * K)
+    dones = batch["terminated"] | batch["truncated"]
+
+    def loss_fn(p):
+        # target policy/value under CURRENT params; behavior logp/values in
+        # the batch came from the stale runner weights
+        logp, entropy = module.logp_entropy(p, obs, actions)
+        v = module.value(p, obs)
+        logp_t = logp.reshape(T, K)
+        v_t = v.reshape(T, K)
+        nv = jnp.concatenate([v_t[1:], batch["next_values"][-1:]], axis=0)
+        nv = jnp.where(dones, batch["next_values"], nv)
+        vs, pg_adv = vtrace_from_fragments(
+            batch["logp"], jax.lax.stop_gradient(logp_t),
+            batch["rewards"], jax.lax.stop_gradient(v_t),
+            jax.lax.stop_gradient(nv), dones, gamma, rho_clip, c_clip)
+        pg_loss = -(jax.lax.stop_gradient(pg_adv) * logp_t).mean()
+        vf_loss = 0.5 * ((v_t - jax.lax.stop_gradient(vs)) ** 2).mean()
+        loss = (pg_loss + vf_loss_coeff * vf_loss
+                - entropy_coeff * entropy.mean())
+        return loss, {
+            "policy_loss": pg_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy.mean(),
+            "mean_vtrace_target": vs.mean(),
+            "mean_is_ratio": jnp.exp(
+                jax.lax.stop_gradient(logp_t) - batch["logp"]).mean(),
+        }
+
+    (loss, stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    stats["total_loss"] = loss
+    return stats, grads
+
+
+def _apply_grads(tx, params, opt_state, grads):
+    import optax
+
+    updates, opt_state = tx.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state
+
+
+def named_parameters(params) -> List[str]:
+    """Stable, stage-count-independent names for every param leaf (e.g.
+    ``pi/0/w``) — the same naming contract
+    ``train/pipeline/partition.py`` keeps across pipeline splits, so a big
+    policy trained under ``JaxTrainer(pipeline_stages=..., mesh=...)``
+    checkpoints and republishes into the mailbox without a rename pass."""
+    import jax
+
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    return ["/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                     for k in path)
+            for path, _ in leaves]
+
+
+class PodracerLearner:
+    """One learner replica (driver-local object or actor — same class)."""
+
+    def __init__(self, module_spec: Dict, training_params: Dict, *,
+                 seed: int = 0, rank: int = 0, world_size: int = 1,
+                 job: str = "", quorum: Optional[int] = None,
+                 platform: Optional[str] = None, publish_every: int = 1,
+                 collective_timeout_s: float = 120.0):
+        if platform == "cpu":
+            from ray_tpu._private.platform import force_cpu_platform
+
+            force_cpu_platform(1)
+        import jax
+        import optax
+
+        from ray_tpu.rllib.core.rl_module import DiscretePolicyModule
+
+        self.module = DiscretePolicyModule(**module_spec)
+        self.config = dict(training_params)
+        self.params = self.module.init(jax.random.PRNGKey(seed))
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(self.config.get("grad_clip", 40.0)),
+            optax.adam(self.config.get("lr", 5e-4)),
+        )
+        self.opt_state = self.tx.init(self.params)
+        self.rank = rank
+        self.world_size = world_size
+        self.job = job
+        self._quorum = quorum
+        self._publish_every = max(int(publish_every), 1)
+        self._timeout_s = collective_timeout_s
+        self._group = None
+        self._updates = 0
+        self._version = 0
+        self._mailbox = None
+        if job and rank == 0:
+            from ray_tpu.rllib.podracer.weights import WeightMailbox
+
+            # keep=4: a runner mid-fetch loses the race only if four
+            # versions roll out during its one object-store get
+            self._mailbox = WeightMailbox(job, keep=4)
+        self._grads = jax.jit(functools.partial(
+            _vtrace_grads, self.module,
+            gamma=self.config.get("gamma", 0.99),
+            rho_clip=self.config.get("rho_clip", 1.0),
+            c_clip=self.config.get("c_clip", 1.0),
+            vf_loss_coeff=self.config.get("vf_loss_coeff", 0.5),
+            entropy_coeff=self.config.get("entropy_coeff", 0.01),
+        ))
+        self._apply = jax.jit(functools.partial(_apply_grads, self.tx))
+
+    # ----------------------------------------------------------- grad fold
+    def _ensure_group(self):
+        if self._group is None and self.world_size > 1:
+            from ray_tpu.util.collective.collective import \
+                get_or_init_collective_group
+
+            self._group = get_or_init_collective_group(
+                self.world_size, self.rank,
+                group_name=f"rllib/{self.job or 'default'}/learners")
+        return self._group
+
+    def update(self, fragment) -> Dict[str, Any]:
+        """One V-trace update; with a gang, folds this rank's gradient with
+        the others' (mean) before applying.  Accepts either a raw batch
+        dict or a streamed fragment wrapper carrying ``{"batch": ...}``."""
+        from ray_tpu.rllib._metrics import rllib_metrics
+
+        batch = fragment.get("batch", fragment) \
+            if isinstance(fragment, dict) else fragment
+        mlabels = {"job": self.job or "default"}
+        t0 = time.monotonic()
+        stats, grads = self._grads(self.params, batch)
+        group = self._ensure_group()
+        if group is not None:
+            from jax.flatten_util import ravel_pytree
+
+            flat, unravel = ravel_pytree(grads)
+            handle = group.allreduce_async(
+                np.asarray(flat), op="mean", quorum=self._quorum,
+                timeout_s=self._timeout_s)
+            folded = handle.wait(self._timeout_s)
+            rllib_metrics()["allreduce_seconds"].observe(
+                handle.op_seconds, mlabels)
+            grads = unravel(folded)
+        self.params, self.opt_state = self._apply(
+            self.params, self.opt_state, grads)
+        self._updates += 1
+        out = {k: float(v) for k, v in stats.items()}
+        if self._mailbox is not None and \
+                self._updates % self._publish_every == 0:
+            self._version = self._mailbox.publish(self.params)
+        out["weight_version"] = float(self._version)
+        rllib_metrics()["update_seconds"].observe(
+            time.monotonic() - t0, mlabels)
+        return out
+
+    # ------------------------------------------------------------ weights
+    def publish(self) -> int:
+        """Publish the current params (v0 before any update, or an
+        off-cycle refresh).  Rank 0 only."""
+        if self._mailbox is None:
+            raise RuntimeError("only rank 0 of a named job publishes")
+        self._version = self._mailbox.publish(self.params)
+        return self._version
+
+    def get_weights(self):
+        return self.params
+
+    def set_weights(self, params) -> None:
+        self.params = params
+
+    def get_version(self) -> int:
+        return self._version
+
+    def param_names(self) -> List[str]:
+        return named_parameters(self.params)
+
+    def nap(self, seconds: float) -> bool:
+        """Occupy this learner's serial call queue for ``seconds`` — a
+        deterministic straggler for quorum tests and benches."""
+        time.sleep(float(seconds))
+        return True
+
+    def ping(self) -> bool:
+        return True
+
+
+class LearnerGang:
+    """Driver-side handle over K PodracerLearner actors.
+
+    Fragments buffer until one is available per rank, then the round
+    dispatches to all ranks at once (the collective fold needs every rank
+    in every op).  With ``quorum=K-1`` the round's stats return after K-1
+    learners finish — the straggler's update keeps running and its result
+    is harvested opportunistically on a later round.
+    """
+
+    def __init__(self, module_spec: Dict, training_params: Dict, *,
+                 num_learners: int, job: str, seed: int = 0,
+                 quorum: Optional[int] = None,
+                 platform: Optional[str] = None, publish_every: int = 1,
+                 round_timeout_s: float = 300.0):
+        import ray_tpu
+
+        if num_learners < 1:
+            raise ValueError("LearnerGang needs num_learners >= 1")
+        cls = ray_tpu.remote(PodracerLearner)
+        self._learners = [
+            cls.options(num_cpus=1).remote(
+                module_spec, training_params, seed=seed, rank=r,
+                world_size=num_learners, job=job, quorum=quorum,
+                platform=platform, publish_every=publish_every)
+            for r in range(num_learners)
+        ]
+        self._await_n = quorum if quorum is not None else num_learners
+        self._timeout_s = round_timeout_s
+        self._buf: List[Any] = []
+        self._straggling: List[Any] = []
+
+    def __len__(self) -> int:
+        return len(self._learners)
+
+    @property
+    def learners(self) -> List[Any]:
+        return list(self._learners)
+
+    def submit(self, fragment_ref) -> List[Dict[str, Any]]:
+        """Queue one fragment (pass the plasma REF, not the value — the
+        learner fetches it without a driver re-put).  Returns the stats
+        dicts of every update that completed as a result (empty until a
+        full round dispatches)."""
+        import ray_tpu
+
+        self._buf.append(fragment_ref)
+        k = len(self._learners)
+        if len(self._buf) < k:
+            return []
+        round_frags, self._buf = self._buf[:k], self._buf[k:]
+        refs = [ln.update.remote(f)
+                for ln, f in zip(self._learners, round_frags)]
+        ready, late = ray_tpu.wait(refs, num_returns=self._await_n,
+                                   timeout=self._timeout_s)
+        if len(ready) < self._await_n:
+            raise TimeoutError(
+                f"learner round: {len(ready)}/{self._await_n} updates "
+                f"finished within {self._timeout_s}s")
+        self._straggling.extend(late)
+        done, self._straggling = ray_tpu.wait(
+            self._straggling, num_returns=len(self._straggling), timeout=0)
+        return ray_tpu.get(ready) + ray_tpu.get(done)
+
+    def flush(self, timeout_s: float = 120.0) -> List[Dict[str, Any]]:
+        """Collect every straggling update (end of run / test barrier)."""
+        import ray_tpu
+
+        done, self._straggling = ray_tpu.wait(
+            self._straggling, num_returns=len(self._straggling),
+            timeout=timeout_s)
+        return ray_tpu.get(done)
+
+    def publish(self) -> int:
+        import ray_tpu
+
+        return ray_tpu.get(self._learners[0].publish.remote(), timeout=60)
+
+    def get_weights(self, rank: int = 0):
+        import ray_tpu
+
+        return ray_tpu.get(self._learners[rank].get_weights.remote(),
+                           timeout=60)
+
+    def stop(self) -> None:
+        import ray_tpu
+
+        for ln in self._learners:
+            try:
+                ray_tpu.kill(ln)
+            except Exception:
+                pass
+        self._learners = []

@@ -16,7 +16,7 @@ Usage:
     python -m ray_tpu lint [PATHS...] [--json] [--baseline PATH]
     python -m ray_tpu timeline [--output PATH]
     python -m ray_tpu profile [--name TASK]
-    python -m ray_tpu summary tasks|serve|data|train|llm|hangs
+    python -m ray_tpu summary tasks|serve|data|train|llm|rllib|hangs
     python -m ray_tpu stack [TASK_ID] [--node NODE_ID]
     python -m ray_tpu logs FILE --follow
 """
@@ -231,6 +231,8 @@ def _cmd_summary(args) -> int:
         _print_train_summary(state.summarize_train())
     elif args.what == "llm":
         _print_llm_summary(state.summarize_llm())
+    elif args.what == "rllib":
+        _print_rllib_summary(state.summarize_rllib())
     elif args.what == "hangs":
         _print_hangs_summary(state.summarize_hangs())
     return 0
@@ -253,6 +255,22 @@ def _print_llm_summary(summary: dict) -> None:
               f"{d['preemptions']:>8g} {d['queue_depth']:>6g} "
               f"{d.get('prefix_hit_rate', 0.0)*100:>5.1f} "
               f"{d.get('shed', 0.0):>5g}")
+
+
+def _print_rllib_summary(summary: dict) -> None:
+    if not summary:
+        print("no rllib metrics recorded yet (is an algorithm training?)")
+        return
+    print(f"{'job':24} {'steps':>9} {'frags':>7} {'ver':>5} "
+          f"{'stale p50':>10} {'stale p95':>10} {'upd ms':>8} "
+          f"{'allr ms':>8} {'inf batch':>10} {'respawns':>9}")
+    for name, d in sorted(summary.items()):
+        print(f"{name:24} {d['env_steps']:>9g} {d['fragments']:>7g} "
+              f"{d['weight_version']:>5g} {d['staleness_p50']:>10.1f} "
+              f"{d['staleness_p95']:>10.1f} {d['update_mean_s']*1e3:>8.2f} "
+              f"{d['allreduce_mean_s']*1e3:>8.2f} "
+              f"{d['inference_batch_mean']:>10.1f} "
+              f"{d['runner_restarts']:>9g}")
 
 
 def _print_hangs_summary(hangs: list) -> None:
@@ -790,10 +808,11 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("summary",
                        help="summarize cluster entities "
-                            "(tasks, serve, data, train, llm, hangs)")
+                            "(tasks, serve, data, train, llm, rllib, "
+                            "hangs)")
     p.add_argument("what",
                    choices=["tasks", "serve", "data", "train", "llm",
-                            "hangs"],
+                            "rllib", "hangs"],
                    help="entity kind to summarize")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=_cmd_summary)

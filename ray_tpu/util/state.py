@@ -198,6 +198,16 @@ def summarize_llm() -> Dict[str, Any]:
     return mv.summarize_llm(_collect_metric_samples())
 
 
+def summarize_rllib() -> Dict[str, Any]:
+    """Per-job Podracer RL view: env-step/fragment throughput, fragment
+    staleness percentiles, learner update + gradient-allreduce latency,
+    Sebulba inference-batch occupancy, published weight version and
+    env-runner respawns (the ray_tpu_rllib_* series)."""
+    from ray_tpu._private import metrics_view as mv
+
+    return mv.summarize_rllib(_collect_metric_samples())
+
+
 def get_stacks(node_id: Optional[str] = None,
                task_id: Optional[str] = None) -> List[Dict[str, Any]]:
     """Live Python stacks across the cluster (the `ray_tpu stack` payload).

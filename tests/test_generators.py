@@ -76,9 +76,50 @@ def test_dynamic_generator_actor_method(cluster):
     out2 = g.annotated.remote(3)
     assert [ray_tpu.get(r, timeout=30) for r in out2] == [0, -1, -2]
 
-    # streaming stays unsupported with an actionable error
-    with pytest.raises(ValueError, match="dynamic"):
-        g.items.options(num_returns="streaming").remote(1)
+
+def test_streaming_generator_task(cluster):
+    """num_returns='streaming' on a TASK: items are consumable as they are
+    produced (each yield seals to plasma immediately); stream() yields
+    in order and the generator still materializes the full ref list."""
+    import time
+
+    @ray_tpu.remote(num_returns="streaming")
+    def slow_gen(n):
+        for i in range(n):
+            time.sleep(0.05)
+            yield i * 2
+
+    g = slow_gen.remote(4)
+    assert g.streaming
+    got = [ray_tpu.get(r, timeout=60) for r in g.stream(timeout_s=60)]
+    assert got == [0, 2, 4, 6]
+
+
+def test_streaming_generator_actor_method(cluster):
+    """Streaming ACTOR methods: the first item is gettable BEFORE the
+    method completes — the property that lets a consumer overlap with a
+    long-running producer loop."""
+    import time
+
+    @ray_tpu.remote
+    class Gen:
+        def items(self, n):
+            for i in range(n):
+                yield 100 + i
+                time.sleep(0.2)
+
+        items.__ray_method_options__ = {"num_returns": "streaming"}
+
+    g = Gen.remote()
+    t0 = time.monotonic()
+    out = g.items.remote(5)
+    first = ray_tpu.get(out.item_ref(0), timeout=60)
+    elapsed = time.monotonic() - t0
+    assert first == 100
+    # 5 items x 0.2s sleep-after-yield: a non-streaming drain takes >= 1s
+    assert elapsed < 0.9, f"first item took {elapsed:.2f}s: not streaming"
+    assert [ray_tpu.get(r, timeout=60) for r in out.stream(timeout_s=60)] \
+        == [100, 101, 102, 103, 104]
 
 
 def test_dynamic_generator_zero_and_error(cluster):

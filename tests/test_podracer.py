@@ -1,0 +1,415 @@
+"""Podracer RL subsystem (rllib/podracer/): streaming env gangs, the
+collective-backed learner gang, and the Sebulba batched-inference tier
+(architectures from arXiv:2104.06272 — Anakin/Sebulba).
+
+Pins the subsystem's load-bearing contracts:
+- bitwise parity: a driver-local learner and a one-actor gang run the
+  identical jit programs, so the same fragments give the same params;
+- backpressure: a runner's unconsumed fragments are bounded by
+  fragments_per_call (+ one draining call's tail);
+- quorum rounds return without the straggler, whose late gradient folds
+  into the next round, and the gang's replicas stay bitwise identical;
+- the Sebulba pool really batches concurrent callers and the runners do
+  ZERO local forward passes;
+- a SIGKILLed env-runner mid-stream becomes a phase-stamped rllib
+  incident with a byte-identical injection trace across two seeded runs.
+"""
+
+import os
+import re
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+SPEC = {"observation_size": 4, "num_actions": 2, "hidden": (16,)}
+TRAIN = {"lr": 5e-4, "gamma": 0.99, "rho_clip": 1.0, "c_clip": 1.0,
+         "vf_loss_coeff": 0.5, "entropy_coeff": 0.01, "grad_clip": 40.0}
+
+
+@pytest.fixture
+def cluster():
+    from conftest import ensure_shared_runtime
+
+    yield ensure_shared_runtime()
+
+
+def _fragment(rng, T=8, K=4):
+    """Synthetic fixed-shape fragment with the exact keys sample() emits."""
+    terminated = rng.random((T, K)) < 0.05
+    return {
+        "obs": rng.standard_normal(
+            (T, K, SPEC["observation_size"])).astype(np.float32),
+        "actions": rng.integers(
+            0, SPEC["num_actions"], (T, K)).astype(np.int32),
+        "logp": np.log(rng.uniform(0.3, 0.7, (T, K))).astype(np.float32),
+        "values": rng.standard_normal((T, K)).astype(np.float32),
+        "rewards": rng.random((T, K)).astype(np.float32),
+        "terminated": terminated,
+        "truncated": np.zeros((T, K), bool),
+        "next_values": rng.standard_normal((T, K)).astype(np.float32),
+    }
+
+
+def _assert_trees_bitwise_equal(a, b):
+    import jax
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert np.array_equal(np.asarray(x), np.asarray(y))
+
+
+# ------------------------------------------------------------------ learner
+
+def test_learner_parity_driver_vs_one_actor_gang(cluster):
+    """Same fragments => same update: a driver-local PodracerLearner and a
+    world_size=1 gang (which skips the collective group entirely) must end
+    bitwise identical — the Anakin/Sebulba parity contract."""
+    from ray_tpu.rllib.podracer import LearnerGang, PodracerLearner
+
+    rng = np.random.default_rng(0)
+    frags = [_fragment(rng) for _ in range(3)]
+
+    local = PodracerLearner(SPEC, TRAIN, seed=0)
+    gang = LearnerGang(SPEC, TRAIN, num_learners=1, job="", seed=0,
+                       platform="cpu")
+    try:
+        for f in frags:
+            local.update(f)
+            stats = gang.submit(ray_tpu.put(f))
+            assert stats and "total_loss" in stats[0]
+        _assert_trees_bitwise_equal(local.get_weights(),
+                                    gang.get_weights(0))
+    finally:
+        gang.stop()
+
+
+def test_learner_param_names_stable(cluster):
+    """named_parameters gives stage-count-independent leaf names — the
+    JaxTrainer pipeline-compat hook (a republished checkpoint needs no
+    rename pass)."""
+    from ray_tpu.rllib.podracer import PodracerLearner
+
+    names = PodracerLearner(SPEC, TRAIN, seed=0).param_names()
+    assert len(names) == len(set(names)) and names == sorted(names)
+    assert all("/" in n for n in names)
+
+
+def test_quorum_round_returns_without_straggler(cluster):
+    """3 learners, quorum=2: a round whose third rank is stuck returns on
+    the first two; the straggler's gradient parks at the root and folds
+    into the next round, and after a flush every rank's params are
+    bitwise identical (each applied the same folded result per round)."""
+    from ray_tpu.rllib.podracer import LearnerGang
+
+    rng = np.random.default_rng(1)
+    gang = LearnerGang(SPEC, TRAIN, num_learners=3, job="", seed=0,
+                       quorum=2, platform="cpu")
+    try:
+        # warmup round: group rendezvous + jit compile off the clock
+        for _ in range(3):
+            gang.submit(ray_tpu.put(_fragment(rng)))
+        nap_ref = gang.learners[2].nap.remote(5.0)
+        t0 = time.monotonic()
+        stats = []
+        for _ in range(3):
+            stats += gang.submit(ray_tpu.put(_fragment(rng)))
+        elapsed = time.monotonic() - t0
+        assert len(stats) >= 2, "quorum round returned no stats"
+        assert elapsed < 4.0, (
+            f"quorum=2 round stalled {elapsed:.1f}s behind the straggler")
+        assert ray_tpu.get(nap_ref, timeout=60) is True
+        gang.flush(timeout_s=120)
+        w0, w1, w2 = (gang.get_weights(r) for r in range(3))
+        _assert_trees_bitwise_equal(w0, w1)
+        _assert_trees_bitwise_equal(w0, w2)
+    finally:
+        gang.stop()
+
+
+# ---------------------------------------------------------------- streaming
+
+@pytest.fixture
+def cartpole_spec():
+    from ray_tpu.rllib.algorithms.algorithm import build_module_spec
+
+    class _Cfg:
+        env = "CartPole-v1"
+        model = {"hidden": (32,)}
+
+    return build_module_spec(_Cfg)
+
+
+def test_stream_backpressure_bounded(cluster, cartpole_spec):
+    """An unconsumed stream stops at fragments_per_call fragments: the
+    runner's next streaming call only launches when the driver drains the
+    previous one — that bound IS the backpressure."""
+    from ray_tpu.rllib.podracer import FragmentStream, PodracerLearner
+
+    from ray_tpu.rllib.env.env_runner import EnvRunner
+
+    T, K, per_call = 8, 2, 2
+    learner = PodracerLearner(cartpole_spec, TRAIN, seed=0)
+    runner = ray_tpu.remote(EnvRunner).options(num_cpus=1).remote(
+        env_name="CartPole-v1", num_envs=K, rollout_length=T,
+        module_spec=cartpole_spec, seed=1000, job="", runner_idx=0)
+    ray_tpu.get(runner.set_weights.remote(learner.get_weights(), 1),
+                timeout=60)
+    stream = FragmentStream([runner], fragments_per_call=per_call,
+                            job="bp-test")
+    # do NOT consume; wait for the first call to drain completely
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        steps = ray_tpu.get(runner.get_debug.remote(),
+                            timeout=60)["lifetime_steps"]
+        if steps >= per_call * T * K:
+            break
+        time.sleep(0.2)
+    # without a drain the runner must NOT start the next call
+    time.sleep(1.0)
+    steps = ray_tpu.get(runner.get_debug.remote(),
+                        timeout=60)["lifetime_steps"]
+    assert steps == per_call * T * K, (
+        f"runner sampled {steps} steps unconsumed; backpressure bound is "
+        f"{per_call * T * K}")
+    # draining releases the next call
+    got = stream.next_fragments(timeout_s=120)
+    assert len(got) == per_call
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if ray_tpu.get(runner.get_debug.remote(),
+                       timeout=60)["lifetime_steps"] > per_call * T * K:
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("stream never relaunched after drain")
+    ray_tpu.kill(runner)
+
+
+# ------------------------------------------------------------------ sebulba
+
+def test_inference_pool_batches_concurrent_callers(cluster, cartpole_spec):
+    """8 concurrent act() calls inside one batching window fold into a
+    single jitted forward (max_batch_occupancy > 1), and every caller gets
+    its own slice back with its own PRNG sampling."""
+    import jax
+
+    from ray_tpu.rllib.podracer import PodracerLearner, create_inference_pool
+
+    learner = PodracerLearner(cartpole_spec, TRAIN, seed=0)
+    pool = create_inference_pool(cartpole_spec, batch_window_s=0.05)
+    try:
+        ray_tpu.get(pool.set_weights.remote(learner.get_weights(), 1),
+                    timeout=120)
+        obs = np.random.default_rng(0).standard_normal(
+            (3, cartpole_spec["observation_size"])).astype(np.float32)
+        keys = [np.asarray(jax.random.PRNGKey(i)) for i in range(8)]
+        # one warmup call compiles the jit outside the occupancy window
+        ray_tpu.get(pool.act.remote(obs, keys[0]), timeout=240)
+        refs = [pool.act.remote(obs, k) for k in keys]
+        outs = ray_tpu.get(refs, timeout=120)
+        for actions, logp, values, version in outs:
+            assert actions.shape == (3,) and values.shape == (3,)
+            assert np.all(logp <= 0) and version == 1
+        stats = ray_tpu.get(pool.get_stats.remote(), timeout=60)
+        assert stats["max_batch_occupancy"] >= 2, stats
+        assert stats["requests"] >= 9
+    finally:
+        ray_tpu.kill(pool)
+
+
+def test_sebulba_impala_zero_local_forwards(cluster):
+    """End-to-end Sebulba IMPALA: runners never run a local forward pass
+    (actions, logp AND bootstrap values all come from the pool), the pool
+    batches more than one runner per iteration, and training still makes
+    policy-version progress."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    config = (IMPALAConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                           rollout_fragment_length=8)
+              .podracer(inference_mode="pool", fragments_per_call=4,
+                        batch_window_s=0.01)
+              .debugging(seed=0))
+    algo = config.build()
+    try:
+        result = None
+        for _ in range(3):
+            result = algo.train()
+        assert result["policy_version"] >= 2
+        assert result["num_env_steps_sampled_lifetime"] >= 3 * 8 * 2
+        debug = ray_tpu.get(
+            [r.get_debug.remote() for r in algo._runners], timeout=120)
+        assert all(d["local_forwards"] == 0 for d in debug), debug
+        stats = ray_tpu.get(algo._pool.get_stats.remote(), timeout=60)
+        assert stats["requests"] > 0
+        assert stats["max_batch_occupancy"] >= 2, (
+            f"pool never batched two runners together: {stats}")
+    finally:
+        algo.stop()
+
+
+def test_streaming_impala_smoke(cluster):
+    """Default-config IMPALA (async_stream=True, local inference): the
+    stream consumes fragments, versions advance, and the result carries
+    the podracer fields."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    config = (IMPALAConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                           rollout_fragment_length=8)
+              .podracer(fragments_per_call=4)
+              .debugging(seed=0))
+    algo = config.build()
+    try:
+        result = None
+        for _ in range(5):
+            result = algo.train()
+        assert result["policy_version"] >= 2
+        assert result["num_fragments_consumed"] >= 1
+        assert result["num_env_steps_sampled_lifetime"] >= 5 * 8 * 2
+        assert "learner/total_loss" in result
+    finally:
+        algo.stop()
+
+
+@pytest.mark.slow
+def test_streaming_impala_cartpole_learns(cluster):
+    """Streaming IMPALA (the new default path) still reaches 350 on
+    CartPole — the learning-quality twin of the relaunch-path test in
+    test_rllib.py."""
+    from ray_tpu.rllib import IMPALAConfig
+
+    config = (IMPALAConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=16,
+                           rollout_fragment_length=64)
+              .training(lr=7e-4, entropy_coeff=0.01)
+              .podracer(fragments_per_call=8)
+              .debugging(seed=0))
+    algo = config.build()
+    try:
+        best = -np.inf
+        result = None
+        for _ in range(400):
+            result = algo.train()
+            best = max(best, result["episode_return_mean"])
+            if best >= 350:
+                break
+            if result["num_env_steps_sampled_lifetime"] > 390_000:
+                break
+        assert best >= 350, (
+            f"did not reach 350 within "
+            f"{result['num_env_steps_sampled_lifetime']} steps "
+            f"(best {best})")
+    finally:
+        algo.stop()
+
+
+# -------------------------------------------------------------------- chaos
+
+class _ChaosRunner:
+    """EnvRunner that can arm the fault-injection engine in ITS process."""
+
+    def __init__(self, **kw):
+        from ray_tpu.rllib.env.env_runner import EnvRunner
+
+        self._inner = EnvRunner(**kw)
+
+    def arm(self, schedule, trace_file):
+        from ray_tpu._private import fault_injection
+        from ray_tpu._private.config import RayConfig
+
+        RayConfig.set("chaos_schedule", schedule)
+        RayConfig.set("chaos_trace_file", trace_file)
+        fault_injection.reset()
+        fault_injection.refresh()
+        return True
+
+    def set_weights(self, params, version=0):
+        return self._inner.set_weights(params, version)
+
+    def run_stream(self, num_fragments):
+        yield from self._inner.run_stream(num_fragments)
+
+    run_stream.__ray_method_options__ = {"num_returns": "streaming"}
+
+    def get_debug(self):
+        return self._inner.get_debug()
+
+
+def test_chaos_env_runner_sigkill_mid_stream(cluster, cartpole_spec,
+                                             tmp_path):
+    """Runner 0 is SIGKILLed at the top of its 3rd sample(): the consumer
+    keeps draining runner 1's stream throughout, opens a phase-stamped
+    rllib incident (detect -> rebuild -> restore), respawns runner 0 and
+    resumes consuming BOTH streams; recovery_seconds{subsystem=rllib} is
+    emitted and the injection trace is byte-identical across two runs."""
+    from ray_tpu._private import incidents
+    from ray_tpu._private.metrics import default_registry
+    from ray_tpu.rllib.podracer import FragmentStream, PodracerLearner
+
+    learner = PodracerLearner(cartpole_spec, TRAIN, seed=0)
+    params = learner.get_weights()
+    schedule = "seed=7;rllib.sample[runner0]=kill@3"
+    T, K = 8, 2
+
+    def spawn(idx, armed, trace):
+        h = ray_tpu.remote(_ChaosRunner).options(num_cpus=1).remote(
+            env_name="CartPole-v1", num_envs=K, rollout_length=T,
+            module_spec=cartpole_spec, seed=1000 * (idx + 1), job="",
+            runner_idx=idx)
+        if armed:
+            ray_tpu.get(h.arm.remote(schedule, trace), timeout=60)
+        ray_tpu.get(h.set_weights.remote(params, 1), timeout=60)
+        return h
+
+    def run_once(tag):
+        trace = str(tmp_path / f"chaos_trace_{tag}.log")
+        runners = [spawn(0, True, trace), spawn(1, False, trace)]
+        respawned = []
+
+        def respawn(idx):
+            h = spawn(idx, False, trace)
+            respawned.append(idx)
+            return h
+
+        stream = FragmentStream(runners, fragments_per_call=4,
+                                respawn=respawn, job=f"chaos-{tag}")
+        n_before = len(incidents.list_local())
+        seen = {0: 0, 1: 0}
+        deadline = time.monotonic() + 240
+        # consume until runner 0 died, was respawned, AND produced again
+        while time.monotonic() < deadline:
+            for idx, _ref, frag in stream.next_fragments(timeout_s=120):
+                seen[idx] += 1
+                assert frag["batch"]["rewards"].shape == (T, K)
+            if respawned and seen[0] >= 4:
+                break
+        assert respawned == [0], f"respawned {respawned}"
+        assert seen[1] >= 2, "surviving stream stalled during recovery"
+        assert seen[0] >= 4, "respawned runner never produced"
+
+        recs = incidents.list_local()[n_before:]
+        mine = [r for r in recs if r["subsystem"] == "rllib"
+                and r["detail"] == "runner0"]
+        assert len(mine) == 1, recs
+        phases = [n for n, _ in map(tuple, mine[0]["phases"])]
+        assert phases[:3] == ["detect", "rebuild", "restore"]
+        assert mine[0]["ok"] and mine[0]["recovery_seconds"] > 0
+        for r in stream.runners:
+            ray_tpu.kill(r)
+        return open(trace).read().splitlines()
+
+    t1, t2 = run_once(1), run_once(2)
+    assert t1 == t2 == ["rllib.sample[runner0]#3:kill"]
+    # the incident layer emitted the recovery histogram for this subsystem
+    text = default_registry.prometheus_text()
+    assert re.search(
+        r'ray_tpu_recovery_seconds_count\{[^}]*subsystem="rllib"', text)
