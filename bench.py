@@ -157,9 +157,19 @@ def _lint_bench() -> dict:
     tier-1 lint gate).  Budget: < 10 s on CPU."""
     from ray_tpu import _lint
 
+    from ray_tpu._lint import wire_contract as _wc
+
     t0 = time.perf_counter()
     result = _lint.run_lint()
     dt = time.perf_counter() - t0
+    # the wire-contract extraction alone (it runs again inside run_lint's
+    # wire-contract pass): the generated-IDL cost and surface size, so the
+    # contract gate's footprint is tracked as the protocol grows
+    t1 = time.perf_counter()
+    pkg_dir = os.path.dirname(os.path.dirname(
+        os.path.abspath(_lint.__file__)))
+    contract = _wc.extract_contract(_lint.collect_files([pkg_dir]))
+    dt_contract = time.perf_counter() - t1
     return {
         "seconds": round(dt, 3),
         "budget_s": 10.0,
@@ -168,6 +178,10 @@ def _lint_bench() -> dict:
         "checkers": len(result.checkers_run),
         "findings": len(result.findings),
         "baselined": len(result.baselined),
+        "contract_extract_seconds": round(dt_contract, 3),
+        "contract_methods": len(contract["methods"]),
+        "contract_call_sites": sum(len(v)
+                                   for v in contract["callers"].values()),
     }
 
 

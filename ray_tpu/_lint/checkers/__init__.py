@@ -14,4 +14,5 @@ from ray_tpu._lint.checkers import (  # noqa: F401
     metrics_hygiene,
     no_flatten,
     tracer_hygiene,
+    wire_contract,
 )

@@ -1297,6 +1297,21 @@ class CoreWorker:
         here in-process with zero external deps)."""
         return self.capture_stacks()
 
+    async def rpc_rpc_stats(self, conn, msg):
+        """Per-method served-RPC counters over this worker's connections
+        ({method: {count, total_s}}) — same surface the GCS and nodelet
+        serve, so any peer holding a direct worker connection (owner,
+        borrower, nodelet) can ask what traffic this process handled when
+        debugging the task path."""
+        agg: Dict[str, list] = {}
+        for c in self.server.connections:
+            for method, (count, total_s) in c.handler_stats().items():
+                st = agg.setdefault(method, [0, 0.0])
+                st[0] += count
+                st[1] += total_s
+        return {m: {"count": v[0], "total_s": v[1]}
+                for m, v in agg.items()}
+
     def capture_stacks(self) -> dict:
         from ray_tpu._private.introspect import capture_thread_stacks
 

@@ -892,7 +892,7 @@ class GcsServer:
         The report may carry the victim's harvested black box (its flight
         recorder's last records), archived for `state.get_blackbox`."""
         wid = msg["worker_id"]
-        self._store_blackbox(msg.get("blackbox"))
+        self._store_blackbox(msg.get("blackbox"), wid, msg.get("node_id"))
         for info in list(self.actors.values()):
             if info.worker_id == wid and info.state in ("ALIVE", "PENDING_CREATION"):
                 await self._handle_actor_failure(
@@ -901,8 +901,18 @@ class GcsServer:
         await self._drop_holder_everywhere(wid)
         return True
 
-    def _store_blackbox(self, bb) -> None:
-        if not bb or not bb.get("worker_id"):
+    def _store_blackbox(self, bb, worker_id=None, node_id=None) -> None:
+        if not bb:
+            return
+        # the notify envelope is authoritative for identity: a harvest ring
+        # that lost its header still files under the reporter's ids
+        if worker_id is not None and not bb.get("worker_id"):
+            bb["worker_id"] = worker_id.hex() \
+                if isinstance(worker_id, bytes) else worker_id
+        if node_id is not None and not bb.get("node_id"):
+            bb["node_id"] = node_id.hex() \
+                if isinstance(node_id, bytes) else node_id
+        if not bb.get("worker_id"):
             return
         self.blackboxes[bb["worker_id"]] = bb
         keep = max(RayConfig.incident_retention, 1)
@@ -912,7 +922,8 @@ class GcsServer:
     async def rpc_blackbox_harvest(self, conn, msg):
         """Archive a harvested ring for a death that had no worker_died
         report (idle worker reaped, surplus pool shrink)."""
-        self._store_blackbox(msg.get("blackbox"))
+        self._store_blackbox(msg.get("blackbox"), msg.get("worker_id"),
+                             msg.get("node_id"))
         return True
 
     async def rpc_get_blackbox(self, conn, msg):
@@ -1126,6 +1137,21 @@ class GcsServer:
                                                         - cap]:
                 del self.profile[key]
         return True
+
+    async def rpc_rpc_stats(self, conn, msg):
+        """Per-method served-RPC counters aggregated over this server's live
+        connections ({method: {count, total_s}}) — the runtime half of the
+        wire contract.  `ray_tpu summary rpc` joins these observed method
+        names against the statically extracted contract snapshot so the two
+        views can't silently diverge."""
+        agg: Dict[str, list] = {}
+        for c in self.server.connections:
+            for method, (count, total_s) in c.handler_stats().items():
+                st = agg.setdefault(method, [0, 0.0])
+                st[0] += count
+                st[1] += total_s
+        return {m: {"count": v[0], "total_s": v[1]}
+                for m, v in agg.items()}
 
     async def rpc_get_profile(self, conn, msg):
         """The cluster profile aggregate, optionally filtered by node /

@@ -561,6 +561,19 @@ class Nodelet:
                 if w.conn is not None and not w.conn.closed
                 and w.state not in ("starting", "dead")]
 
+    async def rpc_rpc_stats(self, conn, msg):
+        """Per-method served-RPC counters over this nodelet's live
+        connections ({method: {count, total_s}}); `ray_tpu summary rpc`
+        cross-checks the observed names against the static wire contract."""
+        agg: Dict[str, list] = {}
+        for c in self.server.connections:
+            for method, (count, total_s) in c.handler_stats().items():
+                st = agg.setdefault(method, [0, 0.0])
+                st[0] += count
+                st[1] += total_s
+        return {m: {"count": v[0], "total_s": v[1]}
+                for m, v in agg.items()}
+
     async def rpc_dump_stacks(self, conn, msg):
         """Fan `dump_stacks` out to every registered worker on this node and
         capture the nodelet's own threads (the `ray_tpu stack` node payload;
@@ -919,6 +932,10 @@ class Nodelet:
             return {"ok": True, "driver": True}
         h.conn = conn
         h.addr = tuple(msg["addr"])
+        # the worker's self-reported pid wins over the spawner's proc.pid:
+        # under a pid namespace the two differ, and the self-reported one is
+        # what appears in the worker's own logs and flight-recorder records
+        h.pid = msg.get("pid", h.pid)
         h.state = "idle"
         h.idle_since = time.monotonic()
         self._starting_count = max(0, self._starting_count - 1)

@@ -1296,7 +1296,8 @@ class RemotePlasmaClient:
             return memoryview(out)
         finally:
             try:
-                self._conn.call_sync("plasma_release", {"oid": oid.binary()})
+                self._conn.call_sync("plasma_release",
+                                     {"oids": [oid.binary()]})
             except ConnectionError:
                 pass
 
@@ -1515,7 +1516,9 @@ def register_store_handlers(handlers: dict, store: PlasmaStore, waiters: dict,
         return store.contains(oid)
 
     async def plasma_release(conn, msg):
-        # singular {"oid"} (legacy) or coalesced {"oids": [...]} releases
+        # coalesced {"oids": [...]} releases; singular {"oid"} kept for
+        # protocol-v1 peers that predate the list form (no current caller
+        # sends it — see docs/WIRE_CONTRACT.md)
         oid_bins = msg.get("oids")
         if oid_bins is None:
             oid_bins = [msg["oid"]]

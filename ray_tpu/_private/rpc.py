@@ -61,6 +61,14 @@ T_REQ, T_RES, T_ERR, T_NOTIFY, T_HELLO = 0, 1, 2, 3, 4
 #            PROTOCOL_VERSION is refused (T_ERR + close).  Peers that
 #            never send T_HELLO (older builds) keep working:
 #            peer_version stays None and no feature gating applies.
+#
+# The per-method schema this frame carries (every registered handler, the
+# request keys it reads, the reply keys it returns, every static call
+# site) is extracted from the tree by the wire-contract lint pass and
+# checked in as docs/WIRE_CONTRACT.md + ray_tpu/_lint/wire_contract.json.
+# Changing the wire surface without bumping PROTOCOL_VERSION below or
+# regenerating the snapshot (`python -m ray_tpu lint --update-contract`)
+# is a wire-contract.drift finding anchored on the next line.
 PROTOCOL_VERSION = 1
 MIN_COMPATIBLE_VERSION = 1
 PROTOCOL_FEATURES = ("pickle5-oob", "batched-tasks", "chunked-pull",

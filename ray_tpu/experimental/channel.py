@@ -53,6 +53,8 @@ _LEN_CLOSE = (1 << 64) - 1
 # always starts with the PROTO opcode (0x80), so a reader can tell the two
 # payload kinds apart and stay compatible with raw-pickle producers
 # (write_bytes of pickle.dumps output, e.g. compiled-DAG error frames).
+# Surfaced in the generated wire contract's frame-type table as DATA_SER
+# (docs/WIRE_CONTRACT.md) — the data plane's counterpart to rpc.py's T_*.
 _SER_FRAME_MAGIC = 0x93
 
 # Chunk size for scatter-gather TCP sends: large OOB buffers are sliced

@@ -235,6 +235,33 @@ def _cmd_summary(args) -> int:
         _print_rllib_summary(state.summarize_rllib())
     elif args.what == "hangs":
         _print_hangs_summary(state.summarize_hangs())
+    elif args.what == "rpc":
+        return _print_rpc_summary(state.summarize_rpc())
+    return 0
+
+
+def _print_rpc_summary(summary: dict) -> int:
+    """Served-RPC traffic per method, cross-checked against the static
+    wire contract (exit 1 if any served method is absent from it)."""
+    methods = summary["methods"]
+    if not methods:
+        print("no RPC handler stats recorded yet "
+              "(RayConfig.event_stats off, or no traffic)")
+        return 0
+    print(f"{'method':32} {'calls':>8} {'total s':>9} {'contract':>8} "
+          f"servers")
+    for name, row in sorted(methods.items()):
+        mark = "ok" if row["in_contract"] else "UNKNOWN"
+        print(f"{name:32} {row['count']:>8} {row['total_s']:>9.3f} "
+              f"{mark:>8} {','.join(row['servers'])}")
+    unknown = summary["unknown"]
+    print(f"{len(methods)} served method(s); contract covers "
+          f"{summary['contract_methods']}")
+    if unknown:
+        print(f"served but NOT in the static contract: "
+              f"{', '.join(unknown)} — regenerate with "
+              f"`python -m ray_tpu lint --update-contract`")
+        return 1
     return 0
 
 
@@ -654,6 +681,8 @@ def _cmd_lint(args) -> int:
         for name, cls in _lint.all_checkers().items():
             print(f"{name:22} {cls.description}")
         return 0
+    if args.contract or args.update_contract:
+        return _lint_contract(args)
     baseline = None if args.no_baseline else (args.baseline
                                               or _lint.DEFAULT_BASELINE)
     checkers = args.select.split(",") if args.select else None
@@ -675,6 +704,59 @@ def _cmd_lint(args) -> int:
     else:
         print(_lint.render_text(result, verbose=args.verbose))
     return 0 if result.ok else 1
+
+
+def _lint_contract(args) -> int:
+    """``ray_tpu lint --contract``: extract the wire contract (the generated
+    IDL of the msgpack RPC plane) and diff it against the checked-in
+    snapshot; ``--update-contract`` regenerates the snapshot JSON plus
+    docs/WIRE_CONTRACT.md.  Exit 0 in sync, 1 drifted."""
+    import os
+
+    from ray_tpu import _lint
+    from ray_tpu._lint import wire_contract as wc
+
+    pkg_dir = os.path.dirname(os.path.dirname(
+        os.path.abspath(_lint.__file__)))
+    files = _lint.collect_files(args.paths or [pkg_dir])
+    contract = wc.extract_contract(files)
+    if args.update_contract:
+        wc.save_snapshot(contract)
+        docs = os.path.join(os.path.dirname(pkg_dir), "docs")
+        md_path = os.path.join(docs, "WIRE_CONTRACT.md")
+        if os.path.isdir(docs):
+            with open(md_path, "w", encoding="utf-8") as fh:
+                fh.write(wc.contract_markdown(contract))
+            print(f"wrote {md_path}")
+        print(f"wrote {wc.DEFAULT_SNAPSHOT} "
+              f"({len(contract['methods'])} methods)")
+        return 0
+    if args.json:
+        print(wc.contract_json(contract), end="")
+    else:
+        p = contract["protocol"]
+        print(f"wire contract: protocol v{p.get('version')} "
+              f"(min compatible v{p.get('min_compatible')}), "
+              f"{len(contract['methods'])} methods, "
+              f"{sum(len(v) for v in contract['callers'].values())} "
+              f"static call sites")
+    snapshot = wc.load_snapshot()
+    if snapshot is None:
+        print("no snapshot checked in — run "
+              "`python -m ray_tpu lint --update-contract`")
+        return 1
+    diff = wc.diff_contract(snapshot, contract)
+    if not diff:
+        if not args.json:
+            print("in sync with snapshot "
+                  f"({os.path.basename(wc.DEFAULT_SNAPSHOT)})")
+        return 0
+    print(f"{len(diff)} difference(s) vs snapshot:")
+    for line in diff:
+        print(f"  {line}")
+    print("bump PROTOCOL_VERSION or run "
+          "`python -m ray_tpu lint --update-contract`")
+    return 1
 
 
 def _cmd_chaos(args) -> int:
@@ -778,6 +860,12 @@ def main(argv=None) -> int:
                    help="also print baselined findings")
     p.add_argument("--list-rules", action="store_true",
                    help="print the checker table and exit")
+    p.add_argument("--contract", action="store_true",
+                   help="print the extracted wire contract + diff vs the "
+                        "checked-in snapshot (exit 1 on drift)")
+    p.add_argument("--update-contract", action="store_true",
+                   help="regenerate the wire-contract snapshot JSON and "
+                        "docs/WIRE_CONTRACT.md from the tree")
     p.set_defaults(fn=_cmd_lint)
 
     p = sub.add_parser(
@@ -809,10 +897,10 @@ def main(argv=None) -> int:
     p = sub.add_parser("summary",
                        help="summarize cluster entities "
                             "(tasks, serve, data, train, llm, rllib, "
-                            "hangs)")
+                            "hangs, rpc)")
     p.add_argument("what",
                    choices=["tasks", "serve", "data", "train", "llm",
-                            "rllib", "hangs"],
+                            "rllib", "hangs", "rpc"],
                    help="entity kind to summarize")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=_cmd_summary)

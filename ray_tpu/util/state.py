@@ -208,6 +208,54 @@ def summarize_rllib() -> Dict[str, Any]:
     return mv.summarize_rllib(_collect_metric_samples())
 
 
+def summarize_rpc() -> Dict[str, Any]:
+    """Served-RPC observability joined against the static wire contract.
+
+    Pulls every server's per-method handler counters (``rpc_stats`` on the
+    GCS and each alive nodelet — recorded when ``RayConfig.event_stats`` is
+    on) and cross-checks the observed method names against the extracted
+    contract snapshot (``ray_tpu/_lint/wire_contract.json``, the generated
+    IDL the ``wire-contract`` lint rules gate).  A method that served
+    traffic but is absent from the contract means the static model and the
+    runtime have diverged — exactly what the join exists to catch.
+
+    Returns ``{methods: {name: {count, total_s, servers, in_contract}},
+    unknown: [names...], contract_methods: N}``.
+    """
+    from ray_tpu._lint import wire_contract as wc
+
+    snapshot = wc.load_snapshot() or {}
+    contract_methods = set(snapshot.get("methods") or {})
+    per_server: Dict[str, Dict[str, Any]] = {}
+    per_server["gcs"] = _gcs_call("rpc_stats", None) or {}
+    for n in list_nodes():
+        if n["state"] != "ALIVE":
+            continue
+        try:
+            per_server[f"nodelet-{n['node_id'][:12]}"] = \
+                _nodelet_call(n["node_id"], "rpc_stats") or {}
+        except Exception:
+            continue  # a dying nodelet must not fail the summary
+    methods: Dict[str, Dict[str, Any]] = {}
+    for server, stats in per_server.items():
+        for m, st in stats.items():
+            row = methods.setdefault(
+                m, {"count": 0, "total_s": 0.0, "servers": []})
+            row["count"] += st["count"]
+            row["total_s"] += st["total_s"]
+            row["servers"].append(server)
+    for m, row in methods.items():
+        row["servers"].sort()
+        row["in_contract"] = (m in contract_methods
+                              or m in wc.INTERNAL_METHODS)
+    return {
+        "methods": methods,
+        "unknown": sorted(m for m, row in methods.items()
+                          if not row["in_contract"]),
+        "contract_methods": len(contract_methods),
+    }
+
+
 def get_stacks(node_id: Optional[str] = None,
                task_id: Optional[str] = None) -> List[Dict[str, Any]]:
     """Live Python stacks across the cluster (the `ray_tpu stack` payload).

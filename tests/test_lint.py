@@ -702,3 +702,234 @@ rec = pickle.dumps({"k": "v"})  # lint: disable=no-flatten (KV record)
 '''
     assert lint_source(src, ["no-flatten"],
                        filename="ray_tpu/_private/x.py") == []
+
+
+# ================================================== wire-contract
+
+_WIRE_SERVER = '''
+class GcsServer:
+    async def rpc_ping(self, conn, msg):
+        node = msg["node_id"]
+        verbose = msg.get("verbose")
+        return {"ok": True}
+'''
+
+
+def test_wire_contract_unknown_method():
+    src = _WIRE_SERVER + '''
+async def client(conn):
+    await conn.call_sync("pingg", {"node_id": b"x"})
+'''
+    findings = lint_source(src, ["wire-contract"])
+    assert rules_of(findings) == ["wire-contract.unknown-method"]
+    assert "pingg" in findings[0].message
+    assert len(fingerprints(findings)) == 1
+
+
+def test_wire_contract_unknown_method_notify_warns_of_silence():
+    src = _WIRE_SERVER + '''
+async def client(conn):
+    await conn.notify("pnig", {"node_id": b"x"})
+'''
+    findings = lint_source(src, ["wire-contract"])
+    assert rules_of(findings) == ["wire-contract.unknown-method"]
+    # a notify gets no Unknown-method error back: the finding says so
+    assert "silently" in findings[0].message
+
+
+def test_wire_contract_batch_and_known_methods_not_flagged():
+    src = _WIRE_SERVER + '''
+async def client(conn):
+    await conn.call("ping", {"node_id": b"x", "verbose": True})
+    await conn.call("__batch__", {"items": []})
+'''
+    assert lint_source(src, ["wire-contract"]) == []
+
+
+def test_wire_contract_key_mismatch_caller_sends_unread_key():
+    src = _WIRE_SERVER + '''
+async def client(conn):
+    await conn.call("ping", {"node_id": b"x", "stale_field": 1})
+'''
+    findings = lint_source(src, ["wire-contract"])
+    assert rules_of(findings) == ["wire-contract.key-mismatch"]
+    assert "stale_field" in findings[0].message
+    assert len(fingerprints(findings)) == 1
+
+
+def test_wire_contract_key_mismatch_handler_requires_unsent_key():
+    src = '''
+class Srv:
+    async def rpc_ping(self, conn, msg):
+        return {"a": msg["node_id"], "b": msg["epoch"]}
+
+async def client(conn):
+    await conn.call("ping", {"node_id": b"x"})
+'''
+    findings = lint_source(src, ["wire-contract"])
+    assert rules_of(findings) == ["wire-contract.key-mismatch"]
+    assert "epoch" in findings[0].message
+    assert len(fingerprints(findings)) == 1
+
+
+def test_wire_contract_dynamic_payload_skips_key_checks():
+    src = '''
+class Srv:
+    async def rpc_sweep(self, conn, msg):
+        for item in msg:
+            handle(item)
+
+async def client(conn, payload):
+    await conn.notify("sweep", payload)
+'''
+    assert lint_source(src, ["wire-contract"]) == []
+
+
+def test_wire_contract_conditional_read_is_optional():
+    """A key read only under a condition (the plasma_release legacy-
+    fallback shape) must not count as required."""
+    src = '''
+class Srv:
+    async def rpc_release(self, conn, msg):
+        oids = msg.get("oids")
+        if oids is None:
+            oids = [msg["oid"]]
+        return len(oids)
+
+async def a(conn):
+    await conn.call("release", {"oids": [b"x"]})
+async def b(conn):
+    await conn.call("release", {"oid": b"x"})
+'''
+    assert lint_source(src, ["wire-contract"]) == []
+
+
+def test_wire_contract_suppression():
+    src = _WIRE_SERVER + '''
+async def client(conn):
+    await conn.notify("pingg", {"node_id": b"x"})  # lint: disable=wire-contract.unknown-method (probing a future server)
+'''
+    assert lint_source(src, ["wire-contract"]) == []
+
+
+_WIRE_RPC_FIXTURE = '''
+PROTOCOL_VERSION = 1
+MIN_COMPATIBLE_VERSION = 1
+
+class Srv:
+    async def rpc_ping(self, conn, msg):
+        return {"ok": msg["x"]}
+
+async def client(conn):
+    await conn.call("ping", {"x": 1})
+'''
+
+
+def _wire_files(src):
+    return [FileCtx("ray_tpu/_private/rpc.py", src)]
+
+
+def test_wire_contract_drift_gate(tmp_path, monkeypatch):
+    """Editing the wire surface without a PROTOCOL_VERSION bump or snapshot
+    regen is exactly one drift finding; the bump declares it and clears."""
+    from ray_tpu._lint import wire_contract as wc
+    from ray_tpu._lint.checkers.wire_contract import WireContractChecker
+
+    snap = tmp_path / "snap.json"
+    wc.save_snapshot(wc.extract_contract(_wire_files(_WIRE_RPC_FIXTURE)),
+                     str(snap))
+    monkeypatch.setattr(WireContractChecker, "snapshot_path", str(snap))
+
+    # in sync: clean
+    r = run_lint(files=_wire_files(_WIRE_RPC_FIXTURE),
+                 checkers=["wire-contract"], baseline=None)
+    assert r.findings == []
+
+    # reply schema changes, no version bump: exactly one fingerprinted drift
+    edited = _WIRE_RPC_FIXTURE.replace('"ok":', '"renamed":')
+    r = run_lint(files=_wire_files(edited),
+                 checkers=["wire-contract"], baseline=None)
+    assert rules_of(r.findings) == ["wire-contract.drift"]
+    assert "PROTOCOL_VERSION" in r.findings[0].message
+    assert r.findings[0].path == "ray_tpu/_private/rpc.py"
+    assert len(fingerprints(r.findings)) == 1
+
+    # bumping the version declares the change: drift clears
+    bumped = edited.replace("PROTOCOL_VERSION = 1", "PROTOCOL_VERSION = 2")
+    r = run_lint(files=_wire_files(bumped),
+                 checkers=["wire-contract"], baseline=None)
+    assert r.findings == []
+
+
+def test_wire_contract_extraction_deterministic():
+    """Two whole-tree extractions render byte-identical snapshot JSON and
+    WIRE_CONTRACT.md."""
+    from ray_tpu._lint import wire_contract as wc
+    from ray_tpu._lint.core import collect_files
+
+    c1 = wc.extract_contract(collect_files([RAY_TPU_DIR]))
+    c2 = wc.extract_contract(collect_files([RAY_TPU_DIR]))
+    assert wc.contract_json(c1) == wc.contract_json(c2)
+    assert wc.contract_markdown(c1) == wc.contract_markdown(c2)
+
+
+def test_checked_in_contract_snapshot_and_doc_are_fresh():
+    """The checked-in snapshot + generated doc must match a fresh
+    extraction byte for byte.  On failure run
+    `python -m ray_tpu lint --update-contract` and commit the result."""
+    from ray_tpu._lint import wire_contract as wc
+    from ray_tpu._lint.core import collect_files
+
+    contract = wc.extract_contract(collect_files([RAY_TPU_DIR]))
+    with open(wc.DEFAULT_SNAPSHOT, encoding="utf-8") as fh:
+        assert fh.read() == wc.contract_json(contract)
+    md_path = os.path.join(os.path.dirname(RAY_TPU_DIR), "docs",
+                           "WIRE_CONTRACT.md")
+    with open(md_path, encoding="utf-8") as fh:
+        assert fh.read() == wc.contract_markdown(contract)
+
+
+def test_wire_contract_tree_gate():
+    """The three wire-contract rules over all of ray_tpu/, with NO baseline
+    escape hatch: zero findings.  Every mismatch they surface is either a
+    real bug (fix it) or a deliberate dynamic payload (inline-suppress with
+    a justification)."""
+    r = run_lint(paths=[RAY_TPU_DIR], checkers=["wire-contract"],
+                 baseline=None)
+    msgs = "\n".join(f"{f.path}:{f.line}: [{f.rule}] {f.message}"
+                     for f in r.findings)
+    assert r.findings == [], f"wire-contract findings:\n{msgs}"
+
+
+def test_wire_contract_snapshot_is_loadable_json():
+    from ray_tpu._lint import wire_contract as wc
+
+    snap = wc.load_snapshot()
+    assert snap is not None
+    assert snap["protocol"]["version"] >= 1
+    assert len(snap["methods"]) >= 100
+    # the servers the ISSUE names are all represented
+    servers = set()
+    for m in snap["methods"].values():
+        servers.update(m["servers"])
+    assert {"GcsServer", "Nodelet", "CoreWorker"} <= servers
+
+
+def test_cli_lint_contract_in_sync(capsys):
+    from ray_tpu.scripts.cli import main
+
+    rc = main(["lint", "--contract"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "in sync with snapshot" in out
+    assert "methods" in out
+
+
+def test_cli_lint_contract_json_is_the_snapshot(capsys):
+    from ray_tpu._lint import wire_contract as wc
+    from ray_tpu.scripts.cli import main
+
+    rc = main(["lint", "--contract", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert json.loads(out) == wc.load_snapshot()

@@ -209,3 +209,25 @@ def test_list_workers(ray_start_regular):
     assert any(w["actor_id"] for w in actors)
     assert all(w["node_id"] for w in workers)
     ray_tpu.kill(a)
+
+
+def test_summarize_rpc_cross_checks_wire_contract(ray_start_regular):
+    """Runtime observability vs the static wire contract: every method that
+    actually served traffic (Connection.handler_stats over the GCS and
+    nodelet servers) must appear in the extracted contract snapshot — the
+    two views of the protocol may not silently diverge."""
+    # drive traffic through the task path so handler stats exist
+    assert ray_tpu.get(_tracked_add.remote(20, 22)) == 42
+
+    summary = state.summarize_rpc()
+    methods = summary["methods"]
+    assert methods, "no RPC handler stats (event_stats defaults on)"
+    served_by = {s for row in methods.values() for s in row["servers"]}
+    assert "gcs" in served_by
+    # the contract covers the full surface and everything observed
+    assert summary["contract_methods"] >= 100
+    assert summary["unknown"] == [], (
+        f"methods served at runtime but absent from the static wire "
+        f"contract: {summary['unknown']}")
+    row = methods[sorted(methods)[0]]
+    assert row["count"] >= 1 and row["total_s"] >= 0.0
